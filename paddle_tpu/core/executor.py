@@ -1,0 +1,262 @@
+"""Executor: lowers a whole Block to ONE jitted XLA computation.
+
+This is the north-star seam (BASELINE.json): the reference Executor walks a
+block and dispatches a C++/CUDA kernel per op
+(``paddle/framework/executor.cc:77,116-129``, ``operator.cc:461-533``); here
+the block is *traced* — each op's JAX compute runs on tracers — and the whole
+program becomes a single ``jax.jit`` computation that XLA fuses and schedules
+for the MXU. Persistable state (parameters, optimizer accumulators, RNG key,
+metric states) lives in a Scope as device arrays and is threaded through the
+jitted function with buffer donation, so parameter updates are in-place in
+HBM.
+
+Differences from the reference, by design:
+* No per-op device contexts / data transforms: XLA owns layout and fusion.
+* Temporaries never materialize in a Scope.
+* Gradients: ``vjp_grad`` ops (appended by backward.py) are linked to their
+  forward op at trace time through a vjp cache — forward activations are
+  shared, nothing is recomputed, and the whole fwd+bwd+update step is still
+  one XLA computation.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import registry
+from .framework import (Program, Variable, default_main_program,
+                        convert_dtype, RNG_STATE_VAR)
+from .scope import global_scope
+
+EMPTY_VAR = "@EMPTY@"
+
+__all__ = ["Executor", "EMPTY_VAR"]
+
+
+def _lookup(env, name, op, block):
+    try:
+        return env[name]
+    except KeyError:
+        reader = op.type if op is not None else "<fetch>"
+        var = block.var_or_none(name)
+        if var is not None and var.persistable:
+            raise RuntimeError(
+                "persistable variable %r read by %r is not initialized in "
+                "scope — run the startup program first" % (name, reader))
+        raise RuntimeError("%r reads undefined variable %r"
+                           % (reader, name)) from None
+
+
+class _TraceState:
+    """Per-trace mutable state shared across ops in one block execution."""
+
+    def __init__(self, needs_vjp):
+        self.vjp_cache = {}   # id(fwd_op) -> (vjp_fn, flat_out_values)
+        self.needs_vjp = needs_vjp
+
+
+def _gather_inputs(op, env, block):
+    values = {}
+    for slot, names in op.inputs.items():
+        values[slot] = [None if n == EMPTY_VAR else _lookup(env, n, op, block)
+                        for n in names]
+    return values
+
+
+def _write_outputs(op, env, norm_result):
+    for slot, names in op.outputs.items():
+        vals = norm_result.get(slot, [])
+        for i, name in enumerate(names):
+            if name == EMPTY_VAR:
+                continue
+            if i < len(vals) and vals[i] is not None:
+                env[name] = vals[i]
+
+
+def _execute_forward_op(op, env, block, trace):
+    opdef = registry.get_op_def(op.type)
+    values = _gather_inputs(op, env, block)
+    rng_key = None
+    if opdef.needs_rng:
+        env[RNG_STATE_VAR], rng_key = jax.random.split(env[RNG_STATE_VAR])
+
+    if id(op) in trace.needs_vjp:
+        in_slots = registry.flat_input_slots(op)
+        out_slots = registry.flat_output_slots(op)
+        flat_vals = [values[slot][i] for slot, i in in_slots]
+
+        def f(*args):
+            vals = {slot: list(lst) for slot, lst in values.items()}
+            for (slot, i), a in zip(in_slots, args):
+                vals[slot][i] = a
+            ctx = registry.ExecContext(op, vals, rng_key=rng_key, block=block)
+            result = registry.normalize_outputs(op, opdef.compute(ctx))
+            return [result.get(slot, [None] * (i + 1))[i] if
+                    i < len(result.get(slot, [])) else None
+                    for slot, i in out_slots]
+
+        outs_flat, vjp_fn = jax.vjp(f, *flat_vals)
+        trace.vjp_cache[id(op)] = (vjp_fn, outs_flat)
+        for (slot, i), val in zip(out_slots, outs_flat):
+            names = op.outputs.get(slot, [])
+            if i < len(names) and val is not None and names[i] != EMPTY_VAR:
+                env[names[i]] = val
+    else:
+        ctx = registry.ExecContext(op, values, rng_key=rng_key, block=block)
+        result = registry.normalize_outputs(op, opdef.compute(ctx))
+        _write_outputs(op, env, result)
+
+
+def _is_float0(x):
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def _execute_vjp_grad(op, env, block, trace):
+    fwd_op = op.attrs["fwd_op"]
+    entry = trace.vjp_cache.get(id(fwd_op))
+    if entry is None:
+        raise RuntimeError(
+            "vjp_grad for op %r executed before its forward op — backward "
+            "ops must follow forward ops in the same block" % fwd_op.type)
+    vjp_fn, outs_flat = entry
+    grad_names = op.inputs.get("OutGrads", [])
+    cots = []
+    for val, gname in zip(outs_flat, grad_names):
+        if val is None:
+            cots.append(None)
+        elif gname == EMPTY_VAR:
+            cots.append(jnp.zeros_like(val))
+        else:
+            g = _lookup(env, gname, op, block)
+            cots.append(jnp.asarray(g, dtype=val.dtype).reshape(val.shape))
+    in_cots = vjp_fn(cots)
+    out_names = op.outputs.get("InGrads", [])
+    for cot, gname in zip(in_cots, out_names):
+        if gname == EMPTY_VAR or cot is None or _is_float0(cot):
+            continue
+        env[gname] = cot
+
+
+def run_block(block, env, trace):
+    """Trace every op of ``block`` against ``env`` (name -> traced value)."""
+    for op in block.ops:
+        if op.type == "vjp_grad":
+            _execute_vjp_grad(op, env, block, trace)
+        else:
+            _execute_forward_op(op, env, block, trace)
+
+
+def _block_io(block):
+    """Classify persistable reads/writes and rng need for a block."""
+    read, written, needs_rng = set(), set(), False
+    for op in block.ops:
+        if op.type != "vjp_grad":
+            if registry.get_op_def(op.type).needs_rng:
+                needs_rng = True
+        for names in op.inputs.values():
+            for n in names:
+                if n == EMPTY_VAR:
+                    continue
+                v = block.var_or_none(n)
+                if v is not None and v.persistable and n not in written:
+                    read.add(n)
+        for names in op.outputs.values():
+            for n in names:
+                if n == EMPTY_VAR:
+                    continue
+                v = block.var_or_none(n)
+                if v is not None and v.persistable:
+                    written.add(n)
+    return read, written, needs_rng
+
+
+class Executor:
+    """Runs Programs. Parity surface: ``fluid.Executor(place).run(...)``
+    (reference ``python/paddle/v2/fluid/executor.py:71,126``)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, donate_state=True):
+        if program is None:
+            program = default_main_program()
+        if not isinstance(program, Program):
+            raise TypeError("Executor.run expects a Program, got %r"
+                            % (program,))
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+        block = program.global_block()
+
+        fetch_names = [v.name if isinstance(v, Variable) else v
+                       for v in fetch_list]
+
+        # Normalize feeds to arrays with var dtype.
+        feed_arrays = {}
+        for name, value in feed.items():
+            var = block.var_or_none(name)
+            dtype = convert_dtype(var.dtype) if var is not None else None
+            arr = jnp.asarray(value, dtype=dtype)
+            feed_arrays[name] = arr
+
+        feed_sig = tuple(sorted((n, tuple(a.shape), str(a.dtype))
+                                for n, a in feed_arrays.items()))
+        key = (id(program), program._version, feed_sig, tuple(fetch_names),
+               bool(donate_state))
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._build(program, block, feed_sig, fetch_names,
+                                   donate_state)
+            self._cache[key] = compiled
+        fn, read_names, written_names, needs_rng = compiled
+
+        state_rw, state_ro = {}, {}
+        for n in written_names:
+            if scope.has_var(n):
+                state_rw[n] = scope.find_var(n)
+        for n in read_names:
+            if n in state_rw:
+                continue
+            if scope.has_var(n):
+                state_ro[n] = scope.find_var(n)
+            # else: executor raises at trace time with a clear message
+        if needs_rng:
+            if not scope.has_var(RNG_STATE_VAR):
+                seed = program.random_seed if program.random_seed else 0
+                scope.set_var(RNG_STATE_VAR, jax.random.PRNGKey(seed))
+            state_rw[RNG_STATE_VAR] = scope.find_var(RNG_STATE_VAR)
+
+        new_state, fetches = fn(state_rw, state_ro, feed_arrays)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        if return_numpy:
+            fetches = [np.asarray(v) for v in fetches]
+        return fetches
+
+    def _build(self, program, block, feed_sig, fetch_names, donate_state):
+        read, written, needs_rng = _block_io(block)
+        if needs_rng:
+            written.add(RNG_STATE_VAR)
+        needs_vjp = {id(op.attrs["fwd_op"]) for op in block.ops
+                     if op.type == "vjp_grad"}
+        written_t = tuple(sorted(written))
+        read_t = tuple(sorted(read - written))
+
+        def fn(state_rw, state_ro, feed):
+            env = {}
+            env.update(state_ro)
+            env.update(state_rw)
+            env.update(feed)
+            trace = _TraceState(needs_vjp)
+            run_block(block, env, trace)
+            new_state = {n: env[n] for n in written_t if n in env}
+            fetches = [_lookup(env, n, None, block) for n in fetch_names]
+            return new_state, fetches
+
+        jit_kwargs = {}
+        if donate_state:
+            jit_kwargs["donate_argnums"] = (0,)
+        return (jax.jit(fn, **jit_kwargs), read_t, written_t, needs_rng)

@@ -1,0 +1,55 @@
+"""Scope: runtime storage for persistable variables.
+
+Parity with the reference Scope/Variable (``paddle/framework/scope.h``), but a
+Scope here only holds *persistable* state (parameters, optimizer accumulators,
+RNG key, metric states) as JAX arrays. Temporaries never materialize: they are
+values inside the traced XLA computation (the reference materialized every
+intermediate in a per-run local scope — ``executor.cc:86-114``).
+"""
+
+import contextlib
+
+import numpy as np
+
+
+class Scope:
+    def __init__(self):
+        self._vars = {}
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def has_var(self, name):
+        return name in self._vars
+
+    def set_var(self, name, value):
+        self._vars[name] = value
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def var_names(self):
+        return list(self._vars)
+
+    def items(self):
+        return self._vars.items()
+
+    def clear(self):
+        self._vars.clear()
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    prev, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = prev

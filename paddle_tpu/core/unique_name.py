@@ -1,0 +1,37 @@
+"""Unique name generation for variables/ops.
+
+Capability parity with the reference's ``unique_integer`` / name mangling in
+``python/paddle/v2/fluid/framework.py`` (``unique_name``), re-done as a plain
+thread-safe counter; no C++ side needed on TPU.
+"""
+
+import threading
+
+
+class _Generator:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+
+    def __call__(self, prefix):
+        with self._lock:
+            idx = self._counters.get(prefix, 0)
+            self._counters[prefix] = idx + 1
+        return "%s_%d" % (prefix, idx)
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+
+
+_generator = _Generator()
+
+
+def generate(prefix):
+    """Return a process-unique name with ``prefix``."""
+    return _generator(prefix)
+
+
+def reset():
+    """Reset all counters (test isolation only)."""
+    _generator.reset()

@@ -1,0 +1,15 @@
+"""Layers API — flat namespace like reference ``fluid.layers``
+(``python/paddle/v2/fluid/layers/``)."""
+
+from .io import *        # noqa: F401,F403
+from .nn import *        # noqa: F401,F403
+from .tensor import *    # noqa: F401,F403
+from .ops import *       # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+
+from . import io, nn, tensor, ops, sequence, control_flow, detection  # noqa
+
+__all__ = (io.__all__ + nn.__all__ + tensor.__all__ + ops.__all__ +
+           sequence.__all__ + control_flow.__all__ + detection.__all__)

@@ -1,0 +1,46 @@
+"""Control-flow layers.
+
+The reference implements While/IfElse/StaticRNN as ops executing sub-blocks
+through the interpreter (``operators/while_op.cc``,
+``fluid/layers/control_flow.py``). TPU-native control flow compiles to
+``lax.scan`` / ``lax.cond`` / ``lax.while_loop`` inside the same XLA
+computation. Round 1 ships the scan-based RNNs (layers/sequence.py) plus the
+building blocks here; While/StaticRNN sub-block tracing lands with the
+seq2seq decoder work.
+"""
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["less_than", "equal", "greater_than", "Print"]
+
+
+def _cmp(op_type, x, y, **kwargs):
+    helper = LayerHelper(op_type, **kwargs)
+    out = helper.create_tmp_variable("bool", stop_gradient=True)
+    helper.append_op(type=op_type, inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def less_than(x, y, **kwargs):
+    return _cmp("less_than", x, y, **kwargs)
+
+
+def equal(x, y, **kwargs):
+    return _cmp("equal", x, y, **kwargs)
+
+
+def greater_than(x, y, **kwargs):
+    return _cmp("greater_than", x, y, **kwargs)
+
+
+def Print(input, message=None, summarize=20, **kwargs):
+    """Debug-print a tensor at execution time (reference print_op) via
+    jax.debug.print — works inside the jitted computation."""
+    helper = LayerHelper("print", **kwargs)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="print", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"message": message or input.name,
+                            "summarize": summarize})
+    return out

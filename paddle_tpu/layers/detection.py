@@ -1,0 +1,21 @@
+"""Detection layers (reference roi_pool_op, detection_output, prior_box,
+multibox_loss — SURVEY A.1/A.2). Round 1: roi_pool; the SSD family follows.
+"""
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["roi_pool"]
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, **kwargs):
+    helper = LayerHelper("roi_pool", **kwargs)
+    out = helper.create_tmp_variable(input.dtype)
+    argmax = helper.create_tmp_variable("int64", stop_gradient=True)
+    helper.append_op(type="roi_pool",
+                     inputs={"X": [input.name], "ROIs": [rois.name]},
+                     outputs={"Out": [out.name], "Argmax": [argmax.name]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out

@@ -1,0 +1,23 @@
+"""Input layers (reference ``fluid/layers/io.py``)."""
+
+from ..core.framework import default_main_program, convert_dtype
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", append_batch_size=True,
+         stop_gradient=True, main_program=None):
+    """Declare a feed variable. ``append_batch_size`` prepends -1 like the
+    reference (``fluid/layers/io.py data``)."""
+    program = main_program or default_main_program()
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = program.global_block()
+    if block.has_var(name):
+        var = block.var(name)
+        var.shape = tuple(shape)
+        var.dtype = convert_dtype(dtype)
+        return var
+    return block.create_var(name=name, shape=shape, dtype=dtype,
+                            stop_gradient=stop_gradient, is_data=True)

@@ -1,0 +1,253 @@
+"""Sequence layers over PADDED batches — the TPU-native replacement for the
+reference's LoD machinery (SURVEY §5.7, B.1).
+
+Design: a "sequence batch" is (data[batch, time, ...], length[batch]) —
+static shapes for XLA, explicit lengths instead of LoD offsets. The
+capability preserved is the same (no quadratic padding waste comes from
+bucketing in the reader, see paddle_tpu.reader); the ops mask padding so
+results match the reference's variable-length semantics exactly.
+
+Covers: sequence_pool (+first/last step), sequence_softmax, sequence_expand,
+sequence_conv, dynamic_lstm, dynamic_gru (lax.scan over time — the analog of
+the fused hl_cuda_lstm kernels / sequence2batch scheduling).
+"""
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = ["sequence_mask", "sequence_pool", "sequence_first_step",
+           "sequence_last_step", "sequence_softmax", "sequence_expand",
+           "sequence_conv", "dynamic_lstm", "dynamic_gru", "gru_unit",
+           "lstm_unit", "sequence_reverse", "sequence_erase_pad",
+           "sequence_slice", "sequence_concat"]
+
+
+def sequence_mask(length, maxlen, dtype="float32", **kwargs):
+    helper = LayerHelper("sequence_mask", **kwargs)
+    out = helper.create_tmp_variable(dtype, stop_gradient=True)
+    helper.append_op(type="sequence_mask",
+                     inputs={"Length": [length.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"maxlen": maxlen, "dtype": dtype})
+    return out
+
+
+def sequence_pool(input, pool_type, length=None, **kwargs):
+    """Pool over time with padding masked (reference sequence_pool_op:
+    average/sum/sqrt/max/last/first)."""
+    helper = LayerHelper("sequence_pool", **kwargs)
+    inputs = {"X": [input.name]}
+    if length is not None:
+        inputs["Length"] = [length.name]
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="sequence_pool", inputs=inputs,
+                     outputs={"Out": [out.name]},
+                     attrs={"pool_type": pool_type})
+    return out
+
+
+def sequence_first_step(input, length=None, **kwargs):
+    return sequence_pool(input, "first", length, **kwargs)
+
+
+def sequence_last_step(input, length=None, **kwargs):
+    return sequence_pool(input, "last", length, **kwargs)
+
+
+def sequence_softmax(input, length=None, **kwargs):
+    helper = LayerHelper("sequence_softmax", **kwargs)
+    inputs = {"X": [input.name]}
+    if length is not None:
+        inputs["Length"] = [length.name]
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="sequence_softmax", inputs=inputs,
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def sequence_expand(x, y, **kwargs):
+    """Broadcast per-sequence rows of ``x`` [b, d] across ``y``'s time axis
+    (padded analog of sequence_expand_op)."""
+    helper = LayerHelper("sequence_expand", **kwargs)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="sequence_expand",
+                     inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def sequence_reverse(x, length=None, **kwargs):
+    helper = LayerHelper("sequence_reverse", **kwargs)
+    inputs = {"X": [x.name]}
+    if length is not None:
+        inputs["Length"] = [length.name]
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="sequence_reverse", inputs=inputs,
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def sequence_erase_pad(x, length, tokens, **kwargs):
+    """Remove tokens from padded int sequences, repacking left
+    (reference sequence_erase_op)."""
+    helper = LayerHelper("sequence_erase", **kwargs)
+    out = helper.create_tmp_variable(x.dtype)
+    new_len = helper.create_tmp_variable("int64", stop_gradient=True)
+    helper.append_op(type="sequence_erase",
+                     inputs={"X": [x.name], "Length": [length.name]},
+                     outputs={"Out": [out.name], "OutLength": [new_len.name]},
+                     attrs={"tokens": list(tokens)})
+    return out, new_len
+
+
+def sequence_slice(input, offset, length_attr, **kwargs):
+    """Slice [offset, offset+length) along time (sequence_slice_op)."""
+    helper = LayerHelper("sequence_slice", **kwargs)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="slice", inputs={"Input": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"axes": [1], "starts": [offset],
+                            "ends": [offset + length_attr]})
+    return out
+
+
+def sequence_concat(inputs, **kwargs):
+    """Concatenate along time (sequence_concat_op on padded batches)."""
+    helper = LayerHelper("sequence_concat", **kwargs)
+    out = helper.create_tmp_variable(inputs[0].dtype)
+    helper.append_op(type="concat",
+                     inputs={"X": [v.name for v in inputs]},
+                     outputs={"Out": [out.name]}, attrs={"axis": 1})
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, param_attr=None,
+                  bias_attr=None, act=None, **kwargs):
+    """Context-window projection over time (reference sequence_conv_op /
+    ContextProjection): same-padding 1-D conv over [batch, time, dim]."""
+    helper = LayerHelper("sequence_conv", act=act, **kwargs)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr,
+                                shape=[filter_size * dim, num_filters],
+                                dtype=input.dtype)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="sequence_conv",
+                     inputs={"X": [input.name], "Filter": [w.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"contextLength": filter_size,
+                            "contextStart": -(filter_size // 2)})
+    if bias_attr is not False:
+        out = helper.append_bias_op(out, ParamAttr.to_attr(bias_attr),
+                                    dim_start=2)
+    return helper.append_activation(out)
+
+
+def dynamic_lstm(input, size, length=None, param_attr=None, bias_attr=None,
+                 use_peepholes=False, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", h0=None, c0=None, **kwargs):
+    """LSTM over padded [batch, time, 4*hidden] projected input (reference
+    dynamic_lstm / LstmLayer / hl_cuda_lstm fused kernels). The time loop is
+    a lax.scan — XLA compiles it to a fused while loop on TPU; padded steps
+    carry state through unchanged (the analog of the shrinking-batch
+    scheduling in sequence2batch, SURVEY B.2).
+
+    ``input`` must already be the gate projection x·W (4*size wide), as in
+    the reference where dynamic_lstm consumes a fc output.
+    """
+    helper = LayerHelper("dynamic_lstm", **kwargs)
+    w = helper.create_parameter(param_attr, shape=[size, 4 * size],
+                                dtype=input.dtype)
+    nbias = 7 * size if use_peepholes else 4 * size
+    bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                   shape=[1, nbias], dtype=input.dtype,
+                                   is_bias=True)
+    inputs = {"Input": [input.name], "Weight": [w.name],
+              "Bias": [bias.name]}
+    if length is not None:
+        inputs["Length"] = [length.name]
+    if h0 is not None:
+        inputs["H0"] = [h0.name]
+    if c0 is not None:
+        inputs["C0"] = [c0.name]
+    hidden = helper.create_tmp_variable(input.dtype)
+    cell = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="dynamic_lstm", inputs=inputs,
+                     outputs={"Hidden": [hidden.name], "Cell": [cell.name]},
+                     attrs={"use_peepholes": use_peepholes,
+                            "is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation})
+    return hidden, cell
+
+
+def dynamic_gru(input, size, length=None, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h0=None, **kwargs):
+    """GRU over padded [batch, time, 3*hidden] projected input (reference
+    dynamic_gru / GatedRecurrentLayer / hl_gpu_gru)."""
+    helper = LayerHelper("dynamic_gru", **kwargs)
+    w = helper.create_parameter(param_attr, shape=[size, 3 * size],
+                                dtype=input.dtype)
+    bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                   shape=[1, 3 * size], dtype=input.dtype,
+                                   is_bias=True)
+    inputs = {"Input": [input.name], "Weight": [w.name],
+              "Bias": [bias.name]}
+    if length is not None:
+        inputs["Length"] = [length.name]
+    if h0 is not None:
+        inputs["H0"] = [h0.name]
+    hidden = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="dynamic_gru", inputs=inputs,
+                     outputs={"Hidden": [hidden.name]},
+                     attrs={"is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "candidate_activation": candidate_activation})
+    return hidden
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid", **kwargs):
+    """Single GRU step (reference gru_unit_op) for explicit RNN loops."""
+    helper = LayerHelper("gru_unit", **kwargs)
+    w = helper.create_parameter(param_attr, shape=[size, 3 * size],
+                                dtype=input.dtype)
+    bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                   shape=[1, 3 * size], dtype=input.dtype,
+                                   is_bias=True)
+    new_hidden = helper.create_tmp_variable(input.dtype)
+    gate = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    reset_h = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    helper.append_op(type="gru_unit",
+                     inputs={"Input": [input.name],
+                             "HiddenPrev": [hidden.name],
+                             "Weight": [w.name], "Bias": [bias.name]},
+                     outputs={"Hidden": [new_hidden.name],
+                              "Gate": [gate.name],
+                              "ResetHiddenPrev": [reset_h.name]},
+                     attrs={"activation": activation,
+                            "gate_activation": gate_activation})
+    return new_hidden, gate, reset_h
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, **kwargs):
+    """Single LSTM step (reference lstm_unit_op): fc([x, h]) -> gates."""
+    from . import nn as _nn
+    from . import tensor as _tensor
+    size = cell_t_prev.shape[-1]
+    concat_in = _tensor.concat([x_t, hidden_t_prev], axis=1, **kwargs)
+    fc_out = _nn.fc(concat_in, 4 * size, param_attr=param_attr,
+                    bias_attr=bias_attr, **kwargs)
+    helper = LayerHelper("lstm_unit", **kwargs)
+    h = helper.create_tmp_variable(x_t.dtype)
+    c = helper.create_tmp_variable(x_t.dtype)
+    helper.append_op(type="lstm_unit",
+                     inputs={"X": [fc_out.name], "C_prev": [cell_t_prev.name]},
+                     outputs={"H": [h.name], "C": [c.name]},
+                     attrs={"forget_bias": forget_bias})
+    return h, c

@@ -1,0 +1,18 @@
+"""Op library: importing this package registers every op.
+
+The TPU-native analog of the reference's ~150-op ``paddle/operators``
+directory (SURVEY N2/A.1): one registry, each op a pure JAX function.
+"""
+
+from . import (  # noqa: F401
+    math_ops,
+    activation_ops,
+    tensor_ops,
+    nn_ops,
+    loss_ops,
+    optimizer_ops,
+    random_ops,
+    metric_ops,
+    sequence_ops,
+    misc_ops,
+)

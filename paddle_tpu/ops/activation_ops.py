@@ -1,0 +1,70 @@
+"""Activation ops — full parity with reference ``activation_op.cc``
+(~28 activations listed in SURVEY A.1) plus legacy gserver activations
+(``ActivationFunction.cpp:72-472``). All are jnp one-liners that XLA fuses
+into neighboring HLO; gradients come from vjp_grad, no per-op grad kernels.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _register(name, fn):
+    @register_op(name)
+    def _compute(ctx, fn=fn):
+        return {"Out": fn(ctx.input("X"), ctx)}
+
+
+_register("sigmoid", lambda x, c: jax.nn.sigmoid(x))
+_register("logsigmoid", lambda x, c: jax.nn.log_sigmoid(x))
+_register("exp", lambda x, c: jnp.exp(x))
+_register("relu", lambda x, c: jax.nn.relu(x))
+_register("tanh", lambda x, c: jnp.tanh(x))
+_register("tanh_shrink", lambda x, c: x - jnp.tanh(x))
+_register("softshrink", lambda x, c: jnp.where(
+    x > c.attr("lambda", 0.5), x - c.attr("lambda", 0.5),
+    jnp.where(x < -c.attr("lambda", 0.5), x + c.attr("lambda", 0.5), 0.0)))
+_register("sqrt", lambda x, c: jnp.sqrt(x))
+_register("abs", lambda x, c: jnp.abs(x))
+_register("ceil", lambda x, c: jnp.ceil(x))
+_register("floor", lambda x, c: jnp.floor(x))
+_register("round", lambda x, c: jnp.round(x))
+_register("reciprocal", lambda x, c: 1.0 / x)
+_register("log", lambda x, c: jnp.log(x))
+_register("square", lambda x, c: jnp.square(x))
+_register("softplus", lambda x, c: jax.nn.softplus(x))
+_register("softsign", lambda x, c: x / (1.0 + jnp.abs(x)))
+_register("brelu", lambda x, c: jnp.clip(x, c.attr("t_min", 0.0),
+                                         c.attr("t_max", 24.0)))
+_register("leaky_relu", lambda x, c: jnp.where(
+    x >= 0, x, x * c.attr("alpha", 0.02)))
+_register("soft_relu", lambda x, c: jnp.log(
+    1.0 + jnp.exp(jnp.clip(x, -c.attr("threshold", 40.0),
+                           c.attr("threshold", 40.0)))))
+_register("elu", lambda x, c: jnp.where(
+    x >= 0, x, c.attr("alpha", 1.0) * (jnp.exp(x) - 1.0)))
+_register("relu6", lambda x, c: jnp.clip(x, 0.0, c.attr("threshold", 6.0)))
+_register("stanh", lambda x, c: c.attr("scale_b", 1.7159) * jnp.tanh(
+    c.attr("scale_a", 2.0 / 3.0) * x))
+_register("hard_shrink", lambda x, c: jnp.where(
+    jnp.abs(x) > c.attr("threshold", 0.5), x, 0.0))
+_register("thresholded_relu", lambda x, c: jnp.where(
+    x > c.attr("threshold", 1.0), x, 0.0))
+_register("hard_sigmoid", lambda x, c: jnp.clip(
+    c.attr("slope", 0.2) * x + c.attr("offset", 0.5), 0.0, 1.0))
+_register("swish", lambda x, c: x * jax.nn.sigmoid(c.attr("beta", 1.0) * x))
+_register("gelu", lambda x, c: jax.nn.gelu(x))
+_register("silu", lambda x, c: jax.nn.silu(x))
+
+
+@register_op("softmax")
+def _softmax(ctx):
+    x = ctx.input("X")
+    return {"Out": jax.nn.softmax(x, axis=-1)}
+
+
+@register_op("prelu")
+def _prelu(ctx):
+    x, alpha = ctx.input("X"), ctx.input("Alpha")
+    return {"Out": jnp.where(x >= 0, x, alpha * x)}

@@ -1,0 +1,177 @@
+"""Loss ops. Parity with reference loss family (SURVEY A.1): cross_entropy,
+softmax_with_cross_entropy, sigmoid_cross_entropy_with_logits, hinge, huber,
+log, margin_rank, modified_huber, rank, smooth_l1, squared_l2_distance (in
+math_ops), nce (sampled softmax, rng), cross-entropy variants.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _take_label_prob(x, label):
+    """x: [N, D] probs; label: [N, 1] int or [N, D] soft."""
+    if jnp.issubdtype(label.dtype, jnp.integer):
+        idx = label.reshape(-1)
+        picked = jnp.take_along_axis(x, idx[:, None], axis=1)
+        return picked
+    return None
+
+
+@register_op("cross_entropy")
+def _cross_entropy(ctx):
+    x, label = ctx.input("X"), ctx.input("Label")
+    if ctx.attr("soft_label", False) or not jnp.issubdtype(label.dtype,
+                                                           jnp.integer):
+        loss = -jnp.sum(label * jnp.log(jnp.clip(x, 1e-20, None)),
+                        axis=1, keepdims=True)
+    else:
+        picked = _take_label_prob(x, label)
+        loss = -jnp.log(jnp.clip(picked, 1e-20, None))
+    return {"Y": loss}
+
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_with_cross_entropy(ctx):
+    logits, label = ctx.input("Logits"), ctx.input("Label")
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        idx = label.reshape(-1)
+        loss = -jnp.take_along_axis(logp, idx[:, None], axis=1)
+    return {"Softmax": jnp.exp(logp), "Loss": loss}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_xent(ctx):
+    x, label = ctx.input("X"), ctx.input("Label")
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": loss}
+
+
+@register_op("square_error_cost")
+def _square_error_cost(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    return {"Out": jnp.square(x - y)}
+
+
+@register_op("hinge_loss")
+def _hinge_loss(ctx):
+    logits, label = ctx.input("Logits"), ctx.input("Labels")
+    return {"Loss": jnp.maximum(0.0, 1.0 - (2.0 * label - 1.0) * logits)}
+
+
+@register_op("huber_loss")
+def _huber_loss(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    delta = ctx.attr("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= delta, 0.5 * jnp.square(r),
+                     delta * (a - 0.5 * delta))
+    return {"Out": loss, "Residual": r}
+
+
+@register_op("log_loss")
+def _log_loss(ctx):
+    p, label = ctx.input("Predicted"), ctx.input("Labels")
+    eps = ctx.attr("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1.0 - label) * jnp.log(1.0 - p + eps)
+    return {"Loss": loss}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ctx):
+    x1, x2, label = ctx.input("X1"), ctx.input("X2"), ctx.input("Label")
+    margin = ctx.attr("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": out, "Activated": (out > 0).astype(x1.dtype)}
+
+
+@register_op("modified_huber_loss")
+def _modified_huber_loss(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    z = (2.0 * y - 1.0) * x
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, jnp.square(1.0 - z), 0.0))
+    return {"Out": loss, "IntermediateVal": z}
+
+
+@register_op("rank_loss")
+def _rank_loss(ctx):
+    left, right, label = ctx.input("Left"), ctx.input("Right"), \
+        ctx.input("Label")
+    d = left - right
+    return {"Out": jnp.log1p(jnp.exp(d)) - label * d}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1_loss(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    sigma = ctx.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    if ctx.has_input("InsideWeight"):
+        diff = diff * ctx.input("InsideWeight")
+    a = jnp.abs(diff)
+    val = jnp.where(a < 1.0 / s2, 0.5 * s2 * jnp.square(diff),
+                    a - 0.5 / s2)
+    if ctx.has_input("OutsideWeight"):
+        val = val * ctx.input("OutsideWeight")
+    loss = jnp.sum(val.reshape(val.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": loss, "Diff": diff}
+
+
+@register_op("nce", needs_rng=True)
+def _nce(ctx):
+    """Noise-contrastive estimation (reference nce_op.cc) with uniform noise
+    sampling on-device."""
+    x, label = ctx.input("Input"), ctx.input("Label")
+    w = ctx.input("Weight")  # [num_classes, dim]
+    num_neg = ctx.attr("num_neg_samples", 10)
+    num_classes = ctx.attr("num_total_classes", w.shape[0])
+    batch = x.shape[0]
+    label = label.reshape(batch, -1)
+    num_true = label.shape[1]
+    samples = jax.random.randint(ctx.rng_key, (batch, num_neg), 0,
+                                 num_classes)
+    all_ids = jnp.concatenate([label, samples], axis=1)  # [b, t+n]
+    wvec = w[all_ids]  # [b, t+n, dim]
+    logits = jnp.einsum("bd,btd->bt", x, wvec)
+    if ctx.has_input("Bias"):
+        logits = logits + ctx.input("Bias").reshape(-1)[all_ids]
+    p_noise = 1.0 / num_classes
+    # logit correction: log(p_model) - log(k * p_noise)
+    corrected = logits - jnp.log(num_neg * p_noise)
+    labels = jnp.concatenate([jnp.ones((batch, num_true)),
+                              jnp.zeros((batch, num_neg))], axis=1)
+    loss = jnp.maximum(corrected, 0.0) - corrected * labels + \
+        jnp.log1p(jnp.exp(-jnp.abs(corrected)))
+    return {"Cost": jnp.sum(loss, axis=1, keepdims=True),
+            "SampleLogits": logits, "SampleLabels": all_ids}
+
+
+@register_op("hsigmoid")
+def _hsigmoid(ctx):
+    """Hierarchical sigmoid over a complete binary tree (reference
+    hierarchical_sigmoid_layer / math/MatrixBitCode SimpleCode): for label l
+    the code is c = l + num_classes; path node j has index (c>>(j+1))-1 and
+    bit (c>>j)&1; loss is the summed sigmoid cross-entropy along the path."""
+    x, w, label = ctx.input("X"), ctx.input("W"), ctx.input("Label")
+    num_classes = ctx.attr("num_classes")
+    max_len = int(2 * num_classes - 1).bit_length() - 1
+    c = label.reshape(-1).astype(jnp.int32) + num_classes
+    js = jnp.arange(max_len)
+    idx = (c[:, None] >> (js[None, :] + 1)) - 1          # [N, L]
+    bit = ((c[:, None] >> js[None, :]) & 1).astype(x.dtype)
+    valid = (idx >= 0).astype(x.dtype)
+    idx = jnp.maximum(idx, 0)
+    wvec = w[idx]                                        # [N, L, D]
+    logits = jnp.einsum("nd,nld->nl", x, wvec)
+    if ctx.has_input("Bias"):
+        logits = logits + ctx.input("Bias").reshape(-1)[idx]
+    ce = jnp.maximum(logits, 0.0) - logits * bit + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return {"Out": jnp.sum(ce * valid, axis=1, keepdims=True)}

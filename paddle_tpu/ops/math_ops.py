@@ -1,0 +1,213 @@
+"""Elementwise / matmul / reduction ops.
+
+Parity with reference ``paddle/operators``: elementwise_*_op.cc, mul_op.cc,
+matmul_op.cc, scale_op.cc, sum_op.cc, mean_op.cc, reduce_op.cc, clip_op.cc,
+minus_op.cc, cos_sim_op.cc, sign, squared_l2_norm, l1_norm, norm.
+TPU-first: each op is one jnp expression; XLA fuses chains of these into the
+surrounding matmul/conv HLO so there is no kernel-launch cost to match.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.framework import convert_dtype
+
+
+def _broadcast_y(x, y, axis):
+    """Reference elementwise broadcast: align Y's dims to X starting at
+    ``axis`` (elementwise_op.h semantics). axis=-1 → trailing alignment."""
+    if x.ndim == y.ndim:
+        return y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    shape = [1] * x.ndim
+    for i, d in enumerate(y.shape):
+        shape[axis + i] = d
+    return y.reshape(shape)
+
+
+def _register_elementwise(name, fn):
+    @register_op("elementwise_" + name)
+    def _compute(ctx, fn=fn):
+        x = ctx.input("X")
+        y = _broadcast_y(x, ctx.input("Y"), ctx.attr("axis", -1))
+        return {"Out": fn(x, y)}
+
+
+_register_elementwise("add", jnp.add)
+_register_elementwise("sub", jnp.subtract)
+_register_elementwise("mul", jnp.multiply)
+_register_elementwise("div", jnp.divide)
+_register_elementwise("max", jnp.maximum)
+_register_elementwise("min", jnp.minimum)
+_register_elementwise("pow", jnp.power)
+
+
+@register_op("mul")
+def _mul(ctx):
+    """Flattening matmul (reference mul_op.cc): X flattened to 2D at
+    x_num_col_dims, Y at y_num_col_dims."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    xd = ctx.attr("x_num_col_dims", 1)
+    yd = ctx.attr("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape(int(np.prod(xs[:xd])), int(np.prod(xs[xd:])))
+    y2 = y.reshape(int(np.prod(ys[:yd])), int(np.prod(ys[yd:])))
+    out = x2 @ y2
+    return {"Out": out.reshape(xs[:xd] + ys[yd:])}
+
+
+@register_op("matmul")
+def _matmul(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    if ctx.attr("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if ctx.attr("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = ctx.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+@register_op("scale")
+def _scale(ctx):
+    x = ctx.input("X")
+    scale = ctx.attr("scale", 1.0)
+    bias = ctx.attr("bias", 0.0)
+    return {"Out": x * scale + bias}
+
+
+@register_op("sum")
+def _sum(ctx):
+    xs = ctx.inputs("X")
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("mean")
+def _mean(ctx):
+    return {"Out": jnp.mean(ctx.input("X"))}
+
+
+@register_op("minus")
+def _minus(ctx):
+    return {"Out": ctx.input("X") - ctx.input("Y")}
+
+
+def _register_reduce(name, fn):
+    @register_op("reduce_" + name)
+    def _compute(ctx, fn=fn):
+        x = ctx.input("X")
+        dim = ctx.attr("dim")
+        keep_dim = ctx.attr("keep_dim", False)
+        if ctx.attr("reduce_all", False) or dim is None:
+            axes = None
+        else:
+            axes = tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
+        return {"Out": fn(x, axis=axes, keepdims=keep_dim)}
+
+
+_register_reduce("sum", jnp.sum)
+_register_reduce("mean", jnp.mean)
+_register_reduce("max", jnp.max)
+_register_reduce("min", jnp.min)
+_register_reduce("prod", jnp.prod)
+
+
+@register_op("clip")
+def _clip(ctx):
+    return {"Out": jnp.clip(ctx.input("X"), ctx.attr("min"), ctx.attr("max"))}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx):
+    x = ctx.input("X")
+    max_norm = ctx.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
+                      1.0).astype(x.dtype)
+    return {"Out": x * scale}
+
+
+@register_op("sign")
+def _sign(ctx):
+    return {"Out": jnp.sign(ctx.input("X"))}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx):
+    return {"Out": jnp.sum(jnp.square(ctx.input("X")))}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    diff = x - y.reshape((-1,) + y.shape[1:])
+    sub = diff.reshape(diff.shape[0], -1)
+    return {"sub_result": diff,
+            "Out": jnp.sum(jnp.square(sub), axis=1, keepdims=True)}
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx):
+    return {"Out": jnp.sum(jnp.abs(ctx.input("X")))}
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx):
+    # out[b, k] = x[b] @ W[k] @ y[b]^T (+ bias)
+    x, y, w = ctx.input("X"), ctx.input("Y"), ctx.input("Weight")
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if ctx.has_input("Bias"):
+        out = out + ctx.input("Bias")
+    return {"Out": out}
+
+
+@register_op("pow")
+def _pow(ctx):
+    return {"Out": jnp.power(ctx.input("X"), ctx.attr("factor", 1.0))}
+
+
+def _register_logical(name, fn, binary=True):
+    @register_op("logical_" + name)
+    def _compute(ctx, fn=fn, binary=binary):
+        x = ctx.input("X")
+        if binary:
+            return {"Out": fn(x, ctx.input("Y"))}
+        return {"Out": fn(x)}
+
+
+_register_logical("and", jnp.logical_and)
+_register_logical("or", jnp.logical_or)
+_register_logical("xor", jnp.logical_xor)
+_register_logical("not", jnp.logical_not, binary=False)
+
+
+def _register_compare(name, fn):
+    @register_op(name)
+    def _compute(ctx, fn=fn):
+        return {"Out": fn(ctx.input("X"), ctx.input("Y"))}
+
+
+_register_compare("less_than", jnp.less)
+_register_compare("less_equal", jnp.less_equal)
+_register_compare("greater_than", jnp.greater)
+_register_compare("greater_equal", jnp.greater_equal)
+_register_compare("equal", jnp.equal)
+_register_compare("not_equal", jnp.not_equal)

@@ -1,0 +1,92 @@
+"""Metric ops: accuracy / auc / precision_recall / edit_distance.
+
+Parity with reference metric ops (``paddle/operators/{accuracy,auc,
+precision_recall,edit_distance}_op``) and legacy evaluators (SURVEY A.4).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("accuracy")
+def _accuracy(ctx):
+    """Top-k indices vs label (reference accuracy_op.cc): Out = hit ratio."""
+    idx = ctx.input("Indices")  # [N, k] from top_k
+    label = ctx.input("Label").reshape(-1, 1)
+    hit = jnp.any(idx == label, axis=1)
+    total = jnp.asarray(idx.shape[0], dtype=jnp.int64)
+    correct = jnp.sum(hit).astype(jnp.int64)
+    return {"Accuracy": (correct.astype(jnp.float32) /
+                         total.astype(jnp.float32)),
+            "Correct": correct, "Total": total}
+
+
+@register_op("auc")
+def _auc(ctx):
+    """Thresholded ROC-AUC approximation (reference auc_op.cc, 200 bins)."""
+    preds = ctx.input("Out")  # [N, 2] or [N] positive-class score
+    label = ctx.input("Label").reshape(-1)
+    if preds.ndim == 2:
+        pos_score = preds[:, -1]
+    else:
+        pos_score = preds
+    num_thresh = ctx.attr("num_thresholds", 200)
+    thresholds = jnp.linspace(0.0, 1.0, num_thresh)
+    pred_pos = pos_score[None, :] > thresholds[:, None]  # [T, N]
+    is_pos = (label > 0)[None, :]
+    tp = jnp.sum(pred_pos & is_pos, axis=1).astype(jnp.float32)
+    fp = jnp.sum(pred_pos & ~is_pos, axis=1).astype(jnp.float32)
+    fn = jnp.sum(~pred_pos & is_pos, axis=1).astype(jnp.float32)
+    tn = jnp.sum(~pred_pos & ~is_pos, axis=1).astype(jnp.float32)
+    tpr = tp / jnp.maximum(tp + fn, 1e-12)
+    fpr = fp / jnp.maximum(fp + tn, 1e-12)
+    # trapezoid over decreasing thresholds
+    auc_val = jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0)
+    return {"AUC": jnp.abs(auc_val)}
+
+
+@register_op("precision_recall")
+def _precision_recall(ctx):
+    """Per-class precision/recall/F1, macro+micro (reference
+    precision_recall_op.cc)."""
+    preds = ctx.input("MaxProbs")
+    idx = ctx.input("Indices").reshape(-1)
+    label = ctx.input("Labels").reshape(-1)
+    num_classes = ctx.attr("class_number")
+    cls = jnp.arange(num_classes)
+    pred_onehot = (idx[:, None] == cls[None, :])
+    label_onehot = (label[:, None] == cls[None, :])
+    tp = jnp.sum(pred_onehot & label_onehot, axis=0).astype(jnp.float32)
+    fp = jnp.sum(pred_onehot & ~label_onehot, axis=0).astype(jnp.float32)
+    fn = jnp.sum(~pred_onehot & label_onehot, axis=0).astype(jnp.float32)
+    precision = tp / jnp.maximum(tp + fp, 1e-12)
+    recall = tp / jnp.maximum(tp + fn, 1e-12)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    macro = jnp.stack([jnp.mean(precision), jnp.mean(recall), jnp.mean(f1)])
+    micro_p = jnp.sum(tp) / jnp.maximum(jnp.sum(tp + fp), 1e-12)
+    micro_r = jnp.sum(tp) / jnp.maximum(jnp.sum(tp + fn), 1e-12)
+    micro_f = 2 * micro_p * micro_r / jnp.maximum(micro_p + micro_r, 1e-12)
+    micro = jnp.stack([micro_p, micro_r, micro_f])
+    return {"BatchMetrics": jnp.concatenate([macro, micro]),
+            "AccumMetrics": jnp.concatenate([macro, micro]),
+            "AccumStatesInfo": jnp.stack([tp, fp, fn], axis=1)}
+
+
+@register_op("positive_negative_pair")
+def _positive_negative_pair(ctx):
+    """PN-pair ranking metric within query groups (reference
+    positive_negative_pair_op.cc), on padded group ids."""
+    score = ctx.input("Score").reshape(-1)
+    label = ctx.input("Label").reshape(-1)
+    qid = ctx.input("QueryID").reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    better = (label[:, None] > label[None, :]) & same_q
+    pos = jnp.sum(better & (score[:, None] > score[None, :]))
+    neg = jnp.sum(better & (score[:, None] < score[None, :]))
+    neu = jnp.sum(better & (score[:, None] == score[None, :]))
+    pos = pos.astype(jnp.float32) + 0.5 * neu
+    neg = neg.astype(jnp.float32) + 0.5 * neu
+    return {"PositivePair": pos, "NegativePair": neg,
+            "NeutralPair": neu.astype(jnp.float32)}

@@ -1,0 +1,56 @@
+"""Misc ops: print (debug), roi_pool."""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("print")
+def _print(ctx):
+    x = ctx.input("X")
+    msg = ctx.attr("message", "print")
+    jax.debug.print(msg + ": {x}", x=x)
+    return {"Out": x}
+
+
+@register_op("roi_pool")
+def _roi_pool(ctx):
+    """ROI max pooling (reference roi_pool_op.cc). ROIs: [n, 5]
+    (batch_idx, x1, y1, x2, y2) in input scale."""
+    x = ctx.input("X")  # [N, C, H, W]
+    rois = ctx.input("ROIs")
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    scale = ctx.attr("spatial_scale", 1.0)
+    _, c, h, w = x.shape
+
+    def pool_one(roi):
+        batch_idx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = x[batch_idx]  # [C, H, W]
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+        # bin index per pixel (pixels outside roi get -1)
+        ybin = jnp.where((ys >= y1) & (ys <= y2),
+                         ((ys - y1) * ph) // rh, -1)
+        xbin = jnp.where((xs >= x1) & (xs <= x2),
+                         ((xs - x1) * pw) // rw, -1)
+        neg = jnp.finfo(x.dtype).min
+        out = jnp.full((c, ph, pw), 0.0, dtype=x.dtype)
+        onehot_y = (ybin[:, None] == jnp.arange(ph)[None, :])  # [H, ph]
+        onehot_x = (xs_bin := (xbin[:, None] == jnp.arange(pw)[None, :]))
+        # max over pixels assigned to each bin
+        masked = jnp.where(onehot_y[None, :, None, :, None] &
+                           onehot_x[None, None, :, None, :],
+                           img[:, :, :, None, None], neg)
+        pooled = jnp.max(masked, axis=(1, 2))
+        return jnp.where(pooled == neg, 0.0, pooled)
+
+    out = jax.vmap(pool_one)(rois.astype(jnp.float32))
+    return {"Out": out, "Argmax": jnp.zeros(out.shape, dtype=jnp.int64)}

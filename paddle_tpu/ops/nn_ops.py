@@ -1,0 +1,324 @@
+"""NN ops: conv / pool / batch_norm / lrn / dropout / maxout / norm.
+
+Parity with reference ``paddle/operators/{conv,conv_transpose,pool,
+pool_with_index,batch_norm,lrn,dropout,maxout,norm,row_conv,conv_shift}_op``
+and their cuDNN variants. TPU-first: convs lower to
+``lax.conv_general_dilated`` (native MXU convs — no im2col, reference
+``operators/math/im2col.cc`` machinery is unnecessary), batch-norm moments
+fuse into surrounding HLO, and layouts stay NCHW logically while XLA picks
+physical tiling.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v, v)
+
+
+@register_op("conv2d")
+def _conv2d(ctx):
+    x, w = ctx.input("Input"), ctx.input("Filter")
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return {"Output": out}
+
+
+@register_op("conv3d")
+def _conv3d(ctx):
+    x, w = ctx.input("Input"), ctx.input("Filter")
+    strides = tuple(ctx.attr("strides", [1, 1, 1]))
+    pads = tuple(ctx.attr("paddings", [0, 0, 0]))
+    dilations = tuple(ctx.attr("dilations", [1, 1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads], rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": out}
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx):
+    x, w = ctx.input("Input"), ctx.input("Filter")  # w: [in, out, kh, kw]
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True)
+    return {"Output": out}
+
+
+def _pool(x, ksize, strides, pads, pooling_type, exclusive=True,
+          global_pooling=False, ceil_mode=False):
+    spatial = x.shape[2:]
+    if global_pooling:
+        ksize = spatial
+        pads = (0,) * len(spatial)
+        strides = (1,) * len(spatial)
+    window = (1, 1) + tuple(ksize)
+    stride = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple(
+        (p, p + (s - 1 if ceil_mode else 0))
+        for p, s in zip(pads, strides))
+    if pooling_type == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, stride,
+                                    padding)
+        return out
+    # avg pooling
+    ones = jnp.ones_like(x)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride,
+                                   padding)
+    if exclusive:
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                       stride, padding)
+    else:
+        counts = float(np.prod(ksize))
+    return summed / counts
+
+
+@register_op("pool2d")
+def _pool2d(ctx):
+    x = ctx.input("X")
+    out = _pool(x, _pair(ctx.attr("ksize")), _pair(ctx.attr("strides",
+                                                            [1, 1])),
+                _pair(ctx.attr("paddings", [0, 0])),
+                ctx.attr("pooling_type", "max"),
+                exclusive=ctx.attr("exclusive", True),
+                global_pooling=ctx.attr("global_pooling", False),
+                ceil_mode=ctx.attr("ceil_mode", False))
+    return {"Out": out}
+
+
+@register_op("pool3d")
+def _pool3d(ctx):
+    x = ctx.input("X")
+    out = _pool(x, tuple(ctx.attr("ksize")),
+                tuple(ctx.attr("strides", [1, 1, 1])),
+                tuple(ctx.attr("paddings", [0, 0, 0])),
+                ctx.attr("pooling_type", "max"),
+                exclusive=ctx.attr("exclusive", True),
+                global_pooling=ctx.attr("global_pooling", False),
+                ceil_mode=ctx.attr("ceil_mode", False))
+    return {"Out": out}
+
+
+@register_op("pool2d_with_index")
+def _pool2d_with_index(ctx):
+    """Max pool returning flattened argmax indices (reference
+    pool_with_index_op). Implemented via one-hot window argmax."""
+    x = ctx.input("X")
+    ksize = _pair(ctx.attr("ksize"))
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    n, c, h, w = x.shape
+    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+    window = (1, 1) + ksize
+    stride = (1, 1) + strides
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+
+    def select(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    out, idx = jax.lax.reduce_window(
+        (x, flat_idx), (-jnp.inf, jnp.float32(-1)),
+        lambda a, b: select(a, b), window, stride, padding)
+    return {"Out": out, "Mask": idx.astype(jnp.int64)}
+
+
+@register_op("batch_norm")
+def _batch_norm(ctx):
+    """Reference batch_norm_op.cc semantics (NCHW): per-channel affine BN,
+    updating running mean/var with ``momentum``; is_test uses running stats.
+    Outputs SavedMean/SavedVariance like the reference (consumed only
+    in-trace by vjp)."""
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    mean, var = ctx.input("Mean"), ctx.input("Variance")
+    momentum = ctx.attr("momentum", 0.9)
+    eps = ctx.attr("epsilon", 1e-5)
+    is_test = ctx.attr("is_test", False)
+    layout = ctx.attr("data_layout", "NCHW")
+    axes = tuple(i for i in range(x.ndim)
+                 if i != (1 if layout == "NCHW" else x.ndim - 1))
+    shape = [1] * x.ndim
+    shape[1 if layout == "NCHW" else x.ndim - 1] = -1
+
+    if is_test:
+        use_mean, use_var = mean, var
+        new_mean, new_var = mean, var
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(use_mean)
+        new_mean = momentum * mean + (1.0 - momentum) * use_mean
+        new_var = momentum * var + (1.0 - momentum) * use_var
+    inv = jax.lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(shape)) * inv.reshape(shape) \
+        * scale.reshape(shape) + bias.reshape(shape)
+    return {"Y": y, "MeanOut": new_mean, "VarianceOut": new_var,
+            "SavedMean": use_mean, "SavedVariance": inv}
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx):
+    x = ctx.input("X")
+    eps = ctx.attr("epsilon", 1e-5)
+    begin = ctx.attr("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    if ctx.has_input("Scale"):
+        y = y * ctx.input("Scale").reshape(x.shape[begin:])
+    if ctx.has_input("Bias"):
+        y = y + ctx.input("Bias").reshape(x.shape[begin:])
+    return {"Y": y, "Mean": mean.reshape(x.shape[:begin]),
+            "Variance": var.reshape(x.shape[:begin])}
+
+
+@register_op("lrn")
+def _lrn(ctx):
+    """Local response norm across channels (reference lrn_op.cc, NCHW)."""
+    x = ctx.input("X")
+    n = ctx.attr("n", 5)
+    k = ctx.attr("k", 2.0)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": x / jnp.power(mid, beta), "MidOut": mid}
+
+
+@register_op("dropout", needs_rng=True)
+def _dropout(ctx):
+    x = ctx.input("X")
+    p = ctx.attr("dropout_prob", 0.5)
+    if ctx.attr("is_test", False):
+        # reference dropout_op.cc test mode: downscale by (1-p)
+        return {"Out": x * (1.0 - p), "Mask": jnp.ones_like(x)}
+    mask = jax.random.bernoulli(ctx.rng_key, 1.0 - p, x.shape).astype(x.dtype)
+    return {"Out": x * mask, "Mask": mask}
+
+
+@register_op("maxout")
+def _maxout(ctx):
+    x = ctx.input("X")
+    groups = ctx.attr("groups")
+    n, c, h, w = x.shape
+    return {"Out": jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2)}
+
+
+@register_op("norm")
+def _norm(ctx):
+    """Cross-channel L2 norm scale (reference norm_op.cc)."""
+    x, scale = ctx.input("X"), ctx.input("Scale")
+    eps = ctx.attr("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+    return {"Out": x / norm * scale.reshape(1, -1, 1, 1)}
+
+
+@register_op("l2_normalize")
+def _l2_normalize(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    eps = ctx.attr("epsilon", 1e-12)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": x / norm, "Norm": norm}
+
+
+@register_op("conv_shift")
+def _conv_shift(ctx):
+    """Circular 1-D correlation (reference conv_shift_op.cc):
+    out[b, i] = sum_j x[b, (i + j - M/2) mod N] * y[b, j]."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    batch, n = x.shape
+    m = y.shape[1]
+    half = m // 2
+    idx = (jnp.arange(n)[:, None] + jnp.arange(m)[None, :] - half) % n
+    gathered = x[:, idx]  # [batch, n, m]
+    return {"Out": jnp.einsum("bnm,bm->bn", gathered, y)}
+
+
+@register_op("row_conv")
+def _row_conv(ctx):
+    """Lookahead row convolution over padded [batch, time, dim] input
+    (reference row_conv_op.cc, LoD variant done on padded batches)."""
+    x, w = ctx.input("X"), ctx.input("Filter")  # w: [future_ctx, dim]
+    ctx_len = w.shape[0]
+    pad = jnp.pad(x, ((0, 0), (0, ctx_len - 1), (0, 0)))
+    out = sum(pad[:, i:i + x.shape[1]] * w[i] for i in range(ctx_len))
+    return {"Out": out}
+
+
+@register_op("spp")
+def _spp(ctx):
+    """Spatial pyramid pooling (reference spp_op.cc)."""
+    x = ctx.input("X")
+    levels = ctx.attr("pyramid_height", 3)
+    pool_type = ctx.attr("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for lvl in range(levels):
+        bins = 2 ** lvl
+        kh, kw = -(-h // bins), -(-w // bins)
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        out = _pool(x, (kh, kw), (kh, kw), (ph, pw), pool_type,
+                    exclusive=False)
+        outs.append(out.reshape(n, -1))
+    return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+@register_op("unpool")
+def _unpool(ctx):
+    """Max-unpooling using indices from pool2d_with_index
+    (reference unpool_op.cc)."""
+    x, idx = ctx.input("X"), ctx.input("Indices")
+    n, c, h, w = x.shape
+    oh, ow = ctx.attr("unpooled_height"), ctx.attr("unpooled_width")
+    flat = jnp.zeros((n, c, oh * ow), dtype=x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda f, i, v: f.at[i].add(v)))(flat, idx.reshape(n, c, -1),
+                                         x.reshape(n, c, -1))
+    return {"Out": out.reshape(n, c, oh, ow)}
+
+
+@register_op("im2sequence")
+def _im2sequence(ctx):
+    """Block-expand: image patches to sequence rows (reference
+    BlockExpandLayer / im2sequence)."""
+    x = ctx.input("X")
+    kh, kw = _pair(ctx.attr("kernels"))
+    sh, sw = _pair(ctx.attr("strides", [1, 1]))
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, ckk, oh, ow = patches.shape
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, ckk)
+    return {"Out": out}

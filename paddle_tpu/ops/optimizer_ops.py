@@ -1,0 +1,168 @@
+"""Optimizer update ops.
+
+Parity with the reference optimizers-as-ops family (SURVEY A.1: sgd,
+momentum, adam, adamax, adagrad, adadelta, decayed_adagrad, proximal_gd,
+proximal_adagrad, ftrl, rmsprop — ``paddle/operators/*_op.cc``) and the
+legacy ``FirstOrderOptimizer.h`` set. TPU-first: updates are pure functions
+appended to the same block as fwd/bwd, so the whole training step is one XLA
+computation and parameter buffers are donated (true in-place HBM update).
+"""
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _lr(ctx):
+    lr = ctx.input("LearningRate")
+    return lr.reshape(()) if hasattr(lr, "reshape") else lr
+
+
+@register_op("sgd")
+def _sgd(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    return {"ParamOut": p - _lr(ctx) * g}
+
+
+@register_op("momentum")
+def _momentum(ctx):
+    p, g, v = ctx.input("Param"), ctx.input("Grad"), ctx.input("Velocity")
+    mu = ctx.attr("mu", 0.9)
+    lr = _lr(ctx)
+    v_new = mu * v + g
+    if ctx.attr("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": p_new, "VelocityOut": v_new}
+
+
+@register_op("adam")
+def _adam(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m, v = ctx.input("Moment1"), ctx.input("Moment2")
+    b1p, b2p = ctx.input("Beta1Pow"), ctx.input("Beta2Pow")
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    lr = _lr(ctx)
+    m_new = b1 * m + (1.0 - b1) * g
+    v_new = b2 * v + (1.0 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1.0 - b2p.reshape(())) / (1.0 - b1p.reshape(()))
+    p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    return {"ParamOut": p_new, "Moment1Out": m_new, "Moment2Out": v_new,
+            "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+
+
+@register_op("adamax")
+def _adamax(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m, u = ctx.input("Moment"), ctx.input("InfNorm")
+    b1p = ctx.input("Beta1Pow")
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    lr = _lr(ctx)
+    m_new = b1 * m + (1.0 - b1) * g
+    u_new = jnp.maximum(b2 * u, jnp.abs(g))
+    p_new = p - (lr / (1.0 - b1p.reshape(()))) * (m_new / (u_new + eps))
+    return {"ParamOut": p_new, "MomentOut": m_new, "InfNormOut": u_new,
+            "Beta1PowOut": b1p * b1}
+
+
+@register_op("adagrad")
+def _adagrad(ctx):
+    p, g, m = ctx.input("Param"), ctx.input("Grad"), ctx.input("Moment")
+    eps = ctx.attr("epsilon", 1e-6)
+    m_new = m + jnp.square(g)
+    p_new = p - _lr(ctx) * g / (jnp.sqrt(m_new) + eps)
+    return {"ParamOut": p_new, "MomentOut": m_new}
+
+
+@register_op("decayed_adagrad")
+def _decayed_adagrad(ctx):
+    p, g, m = ctx.input("Param"), ctx.input("Grad"), ctx.input("Moment")
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    m_new = decay * m + (1.0 - decay) * jnp.square(g)
+    p_new = p - _lr(ctx) * g / (jnp.sqrt(m_new) + eps)
+    return {"ParamOut": p_new, "MomentOut": m_new}
+
+
+@register_op("adadelta")
+def _adadelta(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    avg_sq_grad = ctx.input("AvgSquaredGrad")
+    avg_sq_update = ctx.input("AvgSquaredUpdate")
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    g2 = rho * avg_sq_grad + (1.0 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_update + eps) / (g2 + eps)) * g
+    u2 = rho * avg_sq_update + (1.0 - rho) * jnp.square(update)
+    return {"ParamOut": p + update, "AvgSquaredGradOut": g2,
+            "AvgSquaredUpdateOut": u2}
+
+
+@register_op("rmsprop")
+def _rmsprop(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    ms, mom = ctx.input("MeanSquare"), ctx.input("Moment")
+    rho = ctx.attr("decay", 0.9)
+    mu = ctx.attr("momentum", 0.0)
+    eps = ctx.attr("epsilon", 1e-10)
+    ms_new = rho * ms + (1.0 - rho) * jnp.square(g)
+    mom_new = mu * mom + _lr(ctx) * g / jnp.sqrt(ms_new + eps)
+    return {"ParamOut": p - mom_new, "MeanSquareOut": ms_new,
+            "MomentOut": mom_new}
+
+
+@register_op("proximal_gd")
+def _proximal_gd(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr = _lr(ctx)
+    prox = p - lr * g
+    p_new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / \
+        (1.0 + lr * l2)
+    return {"ParamOut": p_new}
+
+
+@register_op("proximal_adagrad")
+def _proximal_adagrad(ctx):
+    p, g, m = ctx.input("Param"), ctx.input("Grad"), ctx.input("Moment")
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr = _lr(ctx)
+    m_new = m + jnp.square(g)
+    lr_t = lr / jnp.sqrt(m_new)
+    prox = p - lr_t * g
+    p_new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0) / \
+        (1.0 + lr_t * l2)
+    return {"ParamOut": p_new, "MomentOut": m_new}
+
+
+@register_op("ftrl")
+def _ftrl(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    sq_accum, lin_accum = ctx.input("SquaredAccumulator"), \
+        ctx.input("LinearAccumulator")
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr_power = ctx.attr("lr_power", -0.5)
+    lr = _lr(ctx)
+    new_accum = sq_accum + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_accum) - jnp.sqrt(sq_accum)) / lr
+    else:
+        sigma = (jnp.power(new_accum, -lr_power) -
+                 jnp.power(sq_accum, -lr_power)) / lr
+    lin_new = lin_accum + g - sigma * p
+    if lr_power == -0.5:
+        x = l2 + jnp.sqrt(new_accum) / lr
+    else:
+        x = l2 + jnp.power(new_accum, -lr_power) / lr
+    pre_shrink = (jnp.sign(lin_new) * l1 - lin_new) / x
+    p_new = jnp.where(jnp.abs(lin_new) > l1, pre_shrink, 0.0)
+    return {"ParamOut": p_new, "SquaredAccumOut": new_accum,
+            "LinearAccumOut": lin_new}

@@ -1,0 +1,50 @@
+"""RNG ops (reference gaussian_random_op.cc / uniform_random_op.cc).
+
+TPU-first: stateless threaded PRNG — the executor splits the scope-held key
+per op call (reference used per-device curand generators, ``paddle/platform``
+dynload curand).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.framework import convert_dtype
+
+
+@register_op("gaussian_random", needs_rng=True, skip_eval_shape=True)
+def _gaussian_random(ctx):
+    shape = tuple(ctx.attr("shape"))
+    dtype = convert_dtype(ctx.attr("dtype", "float32"))
+    mean = ctx.attr("mean", 0.0)
+    std = ctx.attr("std", 1.0)
+    return {"Out": mean + std * jax.random.normal(ctx.rng_key, shape,
+                                                  dtype=dtype)}
+
+
+@register_op("uniform_random", needs_rng=True, skip_eval_shape=True)
+def _uniform_random(ctx):
+    shape = tuple(ctx.attr("shape"))
+    dtype = convert_dtype(ctx.attr("dtype", "float32"))
+    lo = ctx.attr("min", -1.0)
+    hi = ctx.attr("max", 1.0)
+    return {"Out": jax.random.uniform(ctx.rng_key, shape, dtype=dtype,
+                                      minval=lo, maxval=hi)}
+
+
+@register_op("randint", needs_rng=True, skip_eval_shape=True)
+def _randint(ctx):
+    shape = tuple(ctx.attr("shape"))
+    return {"Out": jax.random.randint(ctx.rng_key, shape,
+                                      ctx.attr("low", 0), ctx.attr("high"),
+                                      dtype=jnp.int64)}
+
+
+@register_op("sampling_id", needs_rng=True)
+def _sampling_id(ctx):
+    """Sample a column index per row from a probability matrix (reference
+    SamplingIdLayer)."""
+    x = ctx.input("X")
+    return {"Out": jax.random.categorical(ctx.rng_key,
+                                          jnp.log(jnp.clip(x, 1e-20, None)),
+                                          axis=-1).astype(jnp.int64)}

@@ -1,0 +1,291 @@
+"""Sequence ops over padded batches + explicit lengths.
+
+TPU-native replacement for the reference's LoD sequence machinery
+(``paddle/operators/sequence_*``, ``operators/math/sequence2batch.h``,
+``hl_cuda_lstm.cu`` / ``hl_gpu_gru.cuh`` fused kernels; SURVEY §5.7, B.1-B.3):
+XLA needs static shapes, so a sequence batch is (data[b, t, ...], length[b]).
+Padding is masked so results equal the reference's ragged semantics; RNN time
+loops are ``lax.scan``, which XLA compiles to a single fused TPU while-loop
+(state flows through padded steps unchanged — same effect as the reference's
+shrinking-batch reordering, without the reorder).
+
+Gate layouts (documented for checkpoint conversion): LSTM gates are ordered
+[input, forget, candidate, output]; GRU is [update, reset | candidate],
+h_t = u*h_{t-1} + (1-u)*c_t.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.framework import convert_dtype
+
+_ACT = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
+        "identity": (lambda x: x), "linear": (lambda x: x)}
+
+
+def _mask_from(ctx, x, time_axis=1):
+    """[batch, time] float mask from optional Length input; all-ones if
+    absent (fully-packed batch)."""
+    t = x.shape[time_axis]
+    if ctx.has_input("Length"):
+        length = ctx.input("Length").reshape(-1)
+        return (jnp.arange(t)[None, :] < length[:, None]).astype(
+            jnp.float32)
+    return jnp.ones((x.shape[0], t), dtype=jnp.float32)
+
+
+@register_op("sequence_mask")
+def _sequence_mask(ctx):
+    length = ctx.input("Length").reshape(-1)
+    maxlen = ctx.attr("maxlen")
+    dtype = convert_dtype(ctx.attr("dtype", "float32"))
+    return {"Out": (jnp.arange(maxlen)[None, :] <
+                    length[:, None]).astype(dtype)}
+
+
+@register_op("sequence_pool")
+def _sequence_pool(ctx):
+    x = ctx.input("X")  # [b, t, ...]
+    pool = ctx.attr("pool_type", "average").lower()
+    mask = _mask_from(ctx, x)
+    mshape = mask.shape + (1,) * (x.ndim - 2)
+    m = mask.reshape(mshape).astype(x.dtype)
+    count = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    if pool in ("average", "avg"):
+        out = jnp.sum(x * m, axis=1) / count
+    elif pool == "sum":
+        out = jnp.sum(x * m, axis=1)
+    elif pool == "sqrt":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(count)
+    elif pool == "max":
+        neg = jnp.asarray(jnp.finfo(x.dtype).min, dtype=x.dtype)
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif pool == "first":
+        out = x[:, 0]
+    elif pool == "last":
+        if ctx.has_input("Length"):
+            idx = (ctx.input("Length").reshape(-1) - 1).astype(jnp.int32)
+            out = jnp.take_along_axis(
+                x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1)
+            out = jnp.squeeze(out, axis=1)
+        else:
+            out = x[:, -1]
+    else:
+        raise ValueError("unknown pool_type %r" % pool)
+    return {"Out": out}
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(ctx):
+    x = ctx.input("X")  # [b, t]
+    mask = _mask_from(ctx, x).astype(x.dtype)
+    neg = jnp.asarray(jnp.finfo(x.dtype).min, dtype=x.dtype)
+    out = jax.nn.softmax(jnp.where(mask > 0, x, neg), axis=1)
+    return {"Out": out * mask}
+
+
+@register_op("sequence_expand")
+def _sequence_expand(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")  # x: [b, d]; y: [b, t, ...]
+    t = y.shape[1]
+    return {"Out": jnp.broadcast_to(x[:, None], (x.shape[0], t) +
+                                    x.shape[1:])}
+
+
+@register_op("sequence_reverse")
+def _sequence_reverse(ctx):
+    """Reverse the VALID prefix of each row, keeping padding at the end
+    (LoD parity: reversal is within each sequence)."""
+    x = ctx.input("X")
+    t = x.shape[1]
+    if ctx.has_input("Length"):
+        length = ctx.input("Length").reshape(-1)
+        idx = length[:, None] - 1 - jnp.arange(t)[None, :]
+        valid = idx >= 0
+        idx = jnp.where(valid, idx, jnp.arange(t)[None, :])
+        out = jnp.take_along_axis(
+            x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+        mask = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
+        out = jnp.where(mask, out, x)
+    else:
+        out = jnp.flip(x, axis=1)
+    return {"Out": out}
+
+
+@register_op("sequence_erase")
+def _sequence_erase(ctx):
+    """Remove listed tokens and left-pack (reference sequence_erase_op)."""
+    x = ctx.input("X")  # [b, t] int
+    length = ctx.input("Length").reshape(-1)
+    tokens = jnp.asarray(ctx.attr("tokens"), dtype=x.dtype)
+    t = x.shape[1]
+    in_range = jnp.arange(t)[None, :] < length[:, None]
+    keep = in_range & ~jnp.isin(x, tokens)
+    # stable sort: kept elements first, original order preserved
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    packed = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int64)
+    out_mask = jnp.arange(t)[None, :] < new_len[:, None]
+    return {"Out": jnp.where(out_mask, packed, 0),
+            "OutLength": new_len}
+
+
+@register_op("sequence_conv")
+def _sequence_conv(ctx):
+    """Context-window projection (reference sequence_conv_op /
+    ContextProjection): gather a sliding window of rows, flatten, matmul."""
+    x = ctx.input("X")  # [b, t, d]
+    w = ctx.input("Filter")  # [ctx_len * d, nf]
+    ctx_len = ctx.attr("contextLength", 3)
+    ctx_start = ctx.attr("contextStart", -(ctx_len // 2))
+    b, t, d = x.shape
+    cols = []
+    for off in range(ctx_start, ctx_start + ctx_len):
+        if off < 0:
+            shifted = jnp.pad(x, ((0, 0), (-off, 0), (0, 0)))[:, :t]
+        elif off > 0:
+            shifted = jnp.pad(x, ((0, 0), (0, off), (0, 0)))[:, off:]
+        else:
+            shifted = x
+        cols.append(shifted)
+    ctx_mat = jnp.concatenate(cols, axis=-1)  # [b, t, ctx_len*d]
+    return {"Out": jnp.einsum("btc,cf->btf", ctx_mat, w)}
+
+
+def _run_lstm(x_proj, w, bias, mask, h0, c0, use_peepholes, acts):
+    """x_proj: [b, t, 4h] pre-projected input; returns hidden/cell [b,t,h]."""
+    act_gate, act_cell, act_cand = acts
+    b, t, four_h = x_proj.shape
+    h = four_h // 4
+    if bias is not None:
+        gate_bias = bias.reshape(-1)[:4 * h]
+        peep = bias.reshape(-1)[4 * h:] if use_peepholes else None
+    else:
+        gate_bias, peep = 0.0, None
+    h_prev = h0 if h0 is not None else jnp.zeros((b, h), x_proj.dtype)
+    c_prev = c0 if c0 is not None else jnp.zeros((b, h), x_proj.dtype)
+
+    xs = jnp.swapaxes(x_proj, 0, 1)  # [t, b, 4h]
+    ms = jnp.swapaxes(mask, 0, 1)[..., None].astype(x_proj.dtype)  # [t,b,1]
+
+    def step(carry, inp):
+        hp, cp = carry
+        x_t, m = inp
+        gates = x_t + hp @ w + gate_bias
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if peep is not None:
+            w_ic, w_fc, w_oc = jnp.split(peep, 3)
+            gi = gi + cp * w_ic
+            gf = gf + cp * w_fc
+        i = act_gate(gi)
+        f = act_gate(gf)
+        cand = act_cand(gc)
+        c_new = f * cp + i * cand
+        if peep is not None:
+            go = go + c_new * w_oc
+        o = act_gate(go)
+        h_new = o * act_cell(c_new)
+        h_new = m * h_new + (1.0 - m) * hp
+        c_new = m * c_new + (1.0 - m) * cp
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h_prev, c_prev), (xs, ms))
+    return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+
+@register_op("dynamic_lstm")
+def _dynamic_lstm(ctx):
+    x = ctx.input("Input")  # [b, t, 4h] pre-projected
+    w = ctx.input("Weight")
+    bias = ctx.input("Bias")
+    mask = _mask_from(ctx, x)
+    acts = (_ACT[ctx.attr("gate_activation", "sigmoid")],
+            _ACT[ctx.attr("cell_activation", "tanh")],
+            _ACT[ctx.attr("candidate_activation", "tanh")])
+    is_rev = ctx.attr("is_reverse", False)
+    if is_rev:
+        x = jnp.flip(x, axis=1)
+        mask = jnp.flip(mask, axis=1)
+    hidden, cell = _run_lstm(x, w, bias, mask,
+                             ctx.input("H0"), ctx.input("C0"),
+                             ctx.attr("use_peepholes", False), acts)
+    if is_rev:
+        hidden = jnp.flip(hidden, axis=1)
+        cell = jnp.flip(cell, axis=1)
+    return {"Hidden": hidden, "Cell": cell}
+
+
+@register_op("dynamic_gru")
+def _dynamic_gru(ctx):
+    x = ctx.input("Input")  # [b, t, 3h]
+    w = ctx.input("Weight")  # [h, 3h]: [update|reset | candidate]
+    bias = ctx.input("Bias")
+    mask = _mask_from(ctx, x)
+    act_gate = _ACT[ctx.attr("gate_activation", "sigmoid")]
+    act_cand = _ACT[ctx.attr("candidate_activation", "tanh")]
+    is_rev = ctx.attr("is_reverse", False)
+    if is_rev:
+        x = jnp.flip(x, axis=1)
+        mask = jnp.flip(mask, axis=1)
+    b, t, three_h = x.shape
+    h = three_h // 3
+    w_g, w_c = w[:, :2 * h], w[:, 2 * h:]
+    bvec = bias.reshape(-1) if bias is not None else jnp.zeros(3 * h,
+                                                               x.dtype)
+    h_prev = ctx.input("H0")
+    if h_prev is None:
+        h_prev = jnp.zeros((b, h), x.dtype)
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)[..., None].astype(x.dtype)
+
+    def step(hp, inp):
+        x_t, m = inp
+        g = x_t[:, :2 * h] + hp @ w_g + bvec[:2 * h]
+        u, r = jnp.split(act_gate(g), 2, axis=-1)
+        c = act_cand(x_t[:, 2 * h:] + (r * hp) @ w_c + bvec[2 * h:])
+        h_new = u * hp + (1.0 - u) * c
+        h_new = m * h_new + (1.0 - m) * hp
+        return h_new, h_new
+
+    _, hs = jax.lax.scan(step, h_prev, (xs, ms))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    if is_rev:
+        hidden = jnp.flip(hidden, axis=1)
+    return {"Hidden": hidden}
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx):
+    x = ctx.input("Input")  # [b, 3h] pre-projected
+    hp = ctx.input("HiddenPrev")
+    w = ctx.input("Weight")
+    bias = ctx.input("Bias")
+    act_gate = _ACT[ctx.attr("gate_activation", "sigmoid")]
+    act_cand = _ACT[ctx.attr("activation", "tanh")]
+    h = hp.shape[-1]
+    bvec = bias.reshape(-1) if bias is not None else 0.0
+    xb = x + bvec
+    g = xb[:, :2 * h] + hp @ w[:, :2 * h]
+    gate = act_gate(g)
+    u, r = jnp.split(gate, 2, axis=-1)
+    reset_h = r * hp
+    c = act_cand(xb[:, 2 * h:] + reset_h @ w[:, 2 * h:])
+    h_new = u * hp + (1.0 - u) * c
+    return {"Hidden": h_new, "Gate": jnp.concatenate([gate, c], axis=-1),
+            "ResetHiddenPrev": reset_h}
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx):
+    x = ctx.input("X")  # [b, 4h] pre-projected (from fc over [x, h])
+    cp = ctx.input("C_prev")
+    forget_bias = ctx.attr("forget_bias", 0.0)
+    gi, gf, gc, go = jnp.split(x, 4, axis=-1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    c_new = f * cp + i * jnp.tanh(gc)
+    h_new = jax.nn.sigmoid(go) * jnp.tanh(c_new)
+    return {"H": h_new, "C": c_new}

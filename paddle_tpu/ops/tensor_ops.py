@@ -1,0 +1,231 @@
+"""Tensor plumbing ops: cast/concat/split/reshape/transpose/pad/crop/expand/
+gather/scatter/top_k/multiplex/fill/assign/one_hot/increment/lookup_table.
+
+Parity with the reference's tensor plumbing rows in SURVEY A.1
+(``paddle/operators/{cast,concat,split,reshape,transpose,pad,crop,expand,
+gather,scatter,top_k,multiplex,fill_constant,assign,increment,
+lookup_table}_op.cc``).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.framework import convert_dtype
+
+
+@register_op("cast")
+def _cast(ctx):
+    dtype = convert_dtype(ctx.attr("out_dtype", ctx.attr("dtype", "float32")))
+    return {"Out": ctx.input("X").astype(dtype)}
+
+
+@register_op("concat")
+def _concat(ctx):
+    return {"Out": jnp.concatenate(ctx.inputs("X"), axis=ctx.attr("axis", 0))}
+
+
+@register_op("split")
+def _split(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    sections = ctx.attr("sections")
+    num = ctx.attr("num")
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("reshape")
+def _reshape(ctx):
+    x = ctx.input("X")
+    shape = list(ctx.attr("shape"))
+    return {"Out": x.reshape(shape)}
+
+
+@register_op("transpose")
+def _transpose(ctx):
+    return {"Out": jnp.transpose(ctx.input("X"), ctx.attr("axis"))}
+
+
+@register_op("pad")
+def _pad(ctx):
+    x = ctx.input("X")
+    paddings = ctx.attr("paddings")  # flat [before0, after0, before1, ...]
+    pads = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pads, constant_values=ctx.attr("pad_value",
+                                                             0.0))}
+
+
+@register_op("crop")
+def _crop(ctx):
+    x = ctx.input("X")
+    offsets = ctx.attr("offsets")
+    shape = ctx.attr("shape")
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": x[slices]}
+
+
+@register_op("expand")
+def _expand(ctx):
+    x = ctx.input("X")
+    times = ctx.attr("expand_times")
+    return {"Out": jnp.tile(x, times)}
+
+
+@register_op("gather")
+def _gather(ctx):
+    x, index = ctx.input("X"), ctx.input("Index")
+    return {"Out": jnp.take(x, index.reshape(-1), axis=0)}
+
+
+@register_op("scatter")
+def _scatter(ctx):
+    # Ref (scatter_op): Out = X; Out[Index] = Updates (overwrite semantics).
+    x, index, updates = ctx.input("X"), ctx.input("Index"), ctx.input(
+        "Updates")
+    return {"Out": x.at[index.reshape(-1)].set(updates)}
+
+
+@register_op("top_k")
+def _top_k(ctx):
+    x = ctx.input("X")
+    k = ctx.attr("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("multiplex")
+def _multiplex(ctx):
+    ids = ctx.input("Ids").reshape(-1)
+    stack = jnp.stack(ctx.inputs("X"), axis=0)  # [n, batch, ...]
+    rows = jnp.arange(stack.shape[1])
+    return {"Out": stack[ids, rows]}
+
+
+@register_op("fill_constant", skip_eval_shape=True)
+def _fill_constant(ctx):
+    shape = tuple(ctx.attr("shape"))
+    dtype = convert_dtype(ctx.attr("dtype", "float32"))
+    return {"Out": jnp.full(shape, ctx.attr("value", 0.0), dtype=dtype)}
+
+
+@register_op("fill_like")
+def _fill_like(ctx):
+    x = ctx.input("X")
+    return {"Out": jnp.full_like(x, ctx.attr("value", 0.0))}
+
+
+@register_op("fill_zeros_like")
+def _fill_zeros_like(ctx):
+    return {"Out": jnp.zeros_like(ctx.input("X"))}
+
+
+@register_op("fill_constant_batch_size_like")
+def _fill_constant_batch_size_like(ctx):
+    x = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    in_idx = ctx.attr("input_dim_idx", 0)
+    out_idx = ctx.attr("output_dim_idx", 0)
+    shape[out_idx] = x.shape[in_idx]
+    dtype = convert_dtype(ctx.attr("dtype", "float32"))
+    return {"Out": jnp.full(tuple(shape), ctx.attr("value", 0.0),
+                            dtype=dtype)}
+
+
+@register_op("assign")
+def _assign(ctx):
+    return {"Out": ctx.input("X")}
+
+
+@register_op("assign_value", skip_eval_shape=True)
+def _assign_value(ctx):
+    values = np.asarray(ctx.attr("values"),
+                        dtype=convert_dtype(ctx.attr("dtype", "float32")))
+    return {"Out": jnp.asarray(values.reshape(ctx.attr("shape")))}
+
+
+@register_op("increment")
+def _increment(ctx):
+    x = ctx.input("X")
+    return {"Out": x + jnp.asarray(ctx.attr("step", 1.0), dtype=x.dtype)}
+
+
+@register_op("is_empty")
+def _is_empty(ctx):
+    x = ctx.input("X")
+    return {"Out": jnp.asarray(x.size == 0)}
+
+
+@register_op("one_hot")
+def _one_hot(ctx):
+    ids = ctx.input("X")
+    depth = ctx.attr("depth")
+    return {"Out": jax.nn.one_hot(ids.reshape(ids.shape[:-1])
+                                  if ids.shape and ids.shape[-1] == 1
+                                  else ids, depth, dtype=jnp.float32)}
+
+
+@register_op("lookup_table")
+def _lookup_table(ctx):
+    """Embedding lookup (reference lookup_table_op.cc). Ids last dim of 1 is
+    squeezed (reference appends a trailing 1 dim). Sparse-grad SelectedRows
+    semantics resolve to dense scatter-add via vjp of take()."""
+    w, ids = ctx.input("W"), ctx.input("Ids")
+    flat = ids.reshape(-1)
+    if ctx.attr("padding_idx") is not None:
+        pad = ctx.attr("padding_idx")
+        emb = jnp.take(w, flat, axis=0)
+        emb = jnp.where((flat == pad)[:, None], 0.0, emb)
+    else:
+        emb = jnp.take(w, flat, axis=0)
+    out_shape = (ids.shape[:-1] if ids.shape and ids.shape[-1] == 1
+                 else ids.shape) + (w.shape[1],)
+    return {"Out": emb.reshape(out_shape)}
+
+
+@register_op("shape")
+def _shape(ctx):
+    return {"Out": jnp.asarray(ctx.input("Input").shape, dtype=jnp.int64)}
+
+
+@register_op("slice")
+def _slice(ctx):
+    x = ctx.input("Input")
+    axes = ctx.attr("axes")
+    starts = ctx.attr("starts")
+    ends = ctx.attr("ends")
+    slices = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        slices[ax] = slice(st, en)
+    return {"Out": x[tuple(slices)]}
+
+
+@register_op("stack")
+def _stack(ctx):
+    return {"Out": jnp.stack(ctx.inputs("X"), axis=ctx.attr("axis", 0))}
+
+
+@register_op("unstack")
+def _unstack(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    return {"Out": [jnp.squeeze(s, axis=axis)
+                    for s in jnp.split(x, x.shape[axis], axis=axis)]}
+
+
+@register_op("arg_max")
+def _arg_max(ctx):
+    return {"Out": jnp.argmax(ctx.input("X"),
+                              axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+
+
+@register_op("arg_min")
+def _arg_min(ctx):
+    return {"Out": jnp.argmin(ctx.input("X"),
+                              axis=ctx.attr("axis", -1)).astype(jnp.int64)}

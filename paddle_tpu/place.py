@@ -1,0 +1,46 @@
+"""Places — device identities (reference ``paddle/platform/place.h:24-53``:
+CPUPlace/CUDAPlace variant). TPU-native: TPUPlace is first-class; CUDAPlace
+kept as an API-compat alias that resolves to whatever accelerator JAX has.
+"""
+
+import jax
+
+__all__ = ["CPUPlace", "TPUPlace", "CUDAPlace", "is_compiled_with_tpu"]
+
+
+class _Place:
+    def __repr__(self):
+        return self.__class__.__name__ + "()"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and \
+            getattr(self, "device_id", 0) == getattr(other, "device_id", 0)
+
+    def __hash__(self):
+        return hash((type(self).__name__, getattr(self, "device_id", 0)))
+
+
+class CPUPlace(_Place):
+    def jax_device(self):
+        return jax.devices("cpu")[0]
+
+
+class TPUPlace(_Place):
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def jax_device(self):
+        devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+class CUDAPlace(TPUPlace):
+    """Compat alias: scripts written against the reference's CUDAPlace run
+    on the default JAX accelerator."""
+
+
+def is_compiled_with_tpu():
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except RuntimeError:
+        return False
