@@ -175,8 +175,12 @@ class Executor:
     """Runs Programs. Parity surface: ``fluid.Executor(place).run(...)``
     (reference ``python/paddle/v2/fluid/executor.py:71,126``)."""
 
-    def __init__(self, place=None):
+    def __init__(self, place=None, strategy=None):
+        """strategy: a parallel.DistStrategy — shards feeds/state over a
+        device mesh; XLA inserts the collectives (replaces the reference's
+        pserver/NCCL tier, SURVEY §5.8)."""
         self.place = place
+        self.strategy = strategy
         self._cache = {}
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -205,7 +209,7 @@ class Executor:
         feed_sig = tuple(sorted((n, tuple(a.shape), str(a.dtype))
                                 for n, a in feed_arrays.items()))
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               bool(donate_state))
+               bool(donate_state), id(self.strategy))
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._build(program, block, feed_sig, fetch_names,
@@ -228,6 +232,17 @@ class Executor:
                 seed = program.random_seed if program.random_seed else 0
                 scope.set_var(RNG_STATE_VAR, jax.random.PRNGKey(seed))
             state_rw[RNG_STATE_VAR] = scope.find_var(RNG_STATE_VAR)
+
+        if self.strategy is not None:
+            # Scatter feeds over the mesh batch axis; pin state to its
+            # PartitionSpec (no-op when already placed). GSPMD propagates
+            # shardings through the step and inserts ICI collectives.
+            feed_arrays = {n: self.strategy.shard_feed(n, a)
+                           for n, a in feed_arrays.items()}
+            state_rw = {n: self.strategy.shard_state(n, a)
+                        for n, a in state_rw.items()}
+            state_ro = {n: self.strategy.shard_state(n, a)
+                        for n, a in state_ro.items()}
 
         new_state, fetches = fn(state_rw, state_ro, feed_arrays)
         for n, v in new_state.items():

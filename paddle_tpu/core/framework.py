@@ -51,7 +51,16 @@ def convert_dtype(dtype):
         dtype = aliases.get(dtype, dtype)
     if dtype == "bfloat16" or dtype is jnp.bfloat16:
         return jnp.bfloat16  # numpy has no bf16; keep the ml_dtypes scalar type
-    return np.dtype(dtype)
+    dt = np.dtype(dtype)
+    # TPU-native dtype policy: no 64-bit fast path on TPU; mirror the
+    # reference's int64 ids / float32 data as int32 / float32 unless the
+    # user enables jax x64.
+    import jax
+    if not jax.config.jax_enable_x64:
+        dt = {np.dtype("int64"): np.dtype("int32"),
+              np.dtype("uint64"): np.dtype("uint32"),
+              np.dtype("float64"): np.dtype("float32")}.get(dt, dt)
+    return dt
 
 
 class Variable:

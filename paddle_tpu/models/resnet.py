@@ -1,0 +1,104 @@
+"""ResNet for ImageNet/CIFAR — the north-star benchmark model
+(reference ``benchmark/paddle/image/resnet.py``: conv_bn_layer /
+shortcut / basicblock / bottleneck; layer_num 50/101/152).
+
+TPU-first notes: NCHW logical layout (XLA picks physical tiling); BN is
+cross-replica under data parallelism for free (SPMD global-view stats);
+use dtype='bfloat16' images + f32 params for the MXU fast path (the
+executor keeps params f32; XLA inserts converts).
+"""
+
+from .. import layers
+from ..param_attr import ParamAttr
+from ..initializer import ConstantInitializer
+
+__all__ = ["resnet_imagenet", "resnet_cifar10"]
+
+
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
+                  is_test=False, name=None):
+    conv = layers.conv2d(input, num_filters=ch_out,
+                         filter_size=filter_size, stride=stride,
+                         padding=padding, act=None, bias_attr=False,
+                         name=name)
+    return layers.batch_norm(conv, act=act, is_test=is_test,
+                             name=None if name is None else name + "_bn")
+
+
+def shortcut(input, ch_out, stride, is_test=False):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, act=None,
+                             is_test=is_test)
+    return input
+
+
+def basicblock(input, ch_out, stride, is_test=False):
+    short = shortcut(input, ch_out, stride, is_test)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None,
+                          is_test=is_test)
+    return layers.elementwise_add(short, conv2, act="relu")
+
+
+def bottleneck(input, ch_out, stride, is_test=False):
+    short = shortcut(input, ch_out * 4, stride, is_test)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_test=is_test)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
+                          is_test=is_test)
+    return layers.elementwise_add(short, conv3, act="relu")
+
+
+def layer_warp(block_func, input, ch_out, count, stride, is_test=False):
+    res = block_func(input, ch_out, stride, is_test)
+    for i in range(1, count):
+        res = block_func(res, ch_out, 1, is_test)
+    return res
+
+
+DEPTH_CFG = {
+    18: (basicblock, [2, 2, 2, 2]),
+    34: (basicblock, [3, 4, 6, 3]),
+    50: (bottleneck, [3, 4, 6, 3]),
+    101: (bottleneck, [3, 4, 23, 3]),
+    152: (bottleneck, [3, 8, 36, 3]),
+}
+
+
+def resnet_imagenet(img, label, depth=50, class_dim=1000, is_test=False):
+    """Reference resnet.py ``resnet_imagenet``: 7x7/2 stem, 3x3/2 maxpool,
+    4 stages, global avg pool, fc softmax."""
+    block, stages = DEPTH_CFG[depth]
+    conv1 = conv_bn_layer(img, 64, 7, 2, 3, is_test=is_test)
+    pool1 = layers.pool2d(conv1, pool_size=3, pool_type="max",
+                          pool_stride=2, pool_padding=1)
+    res1 = layer_warp(block, pool1, 64, stages[0], 1, is_test)
+    res2 = layer_warp(block, res1, 128, stages[1], 2, is_test)
+    res3 = layer_warp(block, res2, 256, stages[2], 2, is_test)
+    res4 = layer_warp(block, res3, 512, stages[3], 2, is_test)
+    pool2 = layers.pool2d(res4, pool_size=7, pool_type="avg",
+                          global_pooling=True)
+    flat_dim = pool2.shape[1]
+    flat = layers.reshape(pool2, [-1, flat_dim])
+    logits = layers.fc(flat, class_dim)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return loss, acc, logits
+
+
+def resnet_cifar10(img, label, depth=32, class_dim=10, is_test=False):
+    """Reference resnet.py ``resnet_cifar10``: 3x3 stem, 3 basicblock
+    stages of n=(depth-2)/6, 8x8 avg pool."""
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(img, 16, 3, 1, 1, is_test=is_test)
+    res1 = layer_warp(basicblock, conv1, 16, n, 1, is_test)
+    res2 = layer_warp(basicblock, res1, 32, n, 2, is_test)
+    res3 = layer_warp(basicblock, res2, 64, n, 2, is_test)
+    pool = layers.pool2d(res3, pool_size=8, pool_type="avg",
+                         global_pooling=True)
+    flat = layers.reshape(pool, [-1, pool.shape[1]])
+    logits = layers.fc(flat, class_dim)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return loss, acc, logits

@@ -16,8 +16,8 @@ def _accuracy(ctx):
     idx = ctx.input("Indices")  # [N, k] from top_k
     label = ctx.input("Label").reshape(-1, 1)
     hit = jnp.any(idx == label, axis=1)
-    total = jnp.asarray(idx.shape[0], dtype=jnp.int64)
-    correct = jnp.sum(hit).astype(jnp.int64)
+    total = jnp.asarray(idx.shape[0], dtype=jnp.int32)
+    correct = jnp.sum(hit).astype(jnp.int32)
     return {"Accuracy": (correct.astype(jnp.float32) /
                          total.astype(jnp.float32)),
             "Correct": correct, "Total": total}

@@ -53,4 +53,4 @@ def _roi_pool(ctx):
         return jnp.where(pooled == neg, 0.0, pooled)
 
     out = jax.vmap(pool_one)(rois.astype(jnp.float32))
-    return {"Out": out, "Argmax": jnp.zeros(out.shape, dtype=jnp.int64)}
+    return {"Out": out, "Argmax": jnp.zeros(out.shape, dtype=jnp.int32)}

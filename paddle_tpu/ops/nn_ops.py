@@ -147,7 +147,7 @@ def _pool2d_with_index(ctx):
     out, idx = jax.lax.reduce_window(
         (x, flat_idx), (-jnp.inf, jnp.float32(-1)),
         lambda a, b: select(a, b), window, stride, padding)
-    return {"Out": out, "Mask": idx.astype(jnp.int64)}
+    return {"Out": out, "Mask": idx.astype(jnp.int32)}
 
 
 @register_op("batch_norm")

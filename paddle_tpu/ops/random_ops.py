@@ -37,7 +37,7 @@ def _randint(ctx):
     shape = tuple(ctx.attr("shape"))
     return {"Out": jax.random.randint(ctx.rng_key, shape,
                                       ctx.attr("low", 0), ctx.attr("high"),
-                                      dtype=jnp.int64)}
+                                      dtype=jnp.int32)}
 
 
 @register_op("sampling_id", needs_rng=True)
@@ -47,4 +47,4 @@ def _sampling_id(ctx):
     x = ctx.input("X")
     return {"Out": jax.random.categorical(ctx.rng_key,
                                           jnp.log(jnp.clip(x, 1e-20, None)),
-                                          axis=-1).astype(jnp.int64)}
+                                          axis=-1).astype(jnp.int32)}

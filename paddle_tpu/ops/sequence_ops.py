@@ -127,7 +127,7 @@ def _sequence_erase(ctx):
     # stable sort: kept elements first, original order preserved
     order = jnp.argsort(~keep, axis=1, stable=True)
     packed = jnp.take_along_axis(x, order, axis=1)
-    new_len = jnp.sum(keep, axis=1).astype(jnp.int64)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
     out_mask = jnp.arange(t)[None, :] < new_len[:, None]
     return {"Out": jnp.where(out_mask, packed, 0),
             "OutLength": new_len}

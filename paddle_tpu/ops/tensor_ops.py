@@ -97,7 +97,7 @@ def _top_k(ctx):
     x = ctx.input("X")
     k = ctx.attr("k", 1)
     vals, idx = jax.lax.top_k(x, k)
-    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+    return {"Out": vals, "Indices": idx.astype(jnp.int32)}
 
 
 @register_op("multiplex")
@@ -191,7 +191,7 @@ def _lookup_table(ctx):
 
 @register_op("shape")
 def _shape(ctx):
-    return {"Out": jnp.asarray(ctx.input("Input").shape, dtype=jnp.int64)}
+    return {"Out": jnp.asarray(ctx.input("Input").shape, dtype=jnp.int32)}
 
 
 @register_op("slice")
@@ -222,10 +222,10 @@ def _unstack(ctx):
 @register_op("arg_max")
 def _arg_max(ctx):
     return {"Out": jnp.argmax(ctx.input("X"),
-                              axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+                              axis=ctx.attr("axis", -1)).astype(jnp.int32)}
 
 
 @register_op("arg_min")
 def _arg_min(ctx):
     return {"Out": jnp.argmin(ctx.input("X"),
-                              axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+                              axis=ctx.attr("axis", -1)).astype(jnp.int32)}

@@ -1,0 +1,152 @@
+"""OpTest harness: output checks + numeric-vs-analytic gradient checks.
+
+Port of the reference's test backbone (SURVEY B.8;
+``python/paddle/v2/fluid/tests/op_test.py:97-211,342-360``): perturb each
+input element by ±delta, estimate dL/dx by central difference, compare to
+the analytic gradient from append_backward with a max-relative-error
+threshold. Here the "L" is sum(outputs) like the reference's default.
+"""
+
+import numpy as np
+
+import paddle_tpu as ptpu
+from paddle_tpu.core.backward import append_backward
+
+
+class OpTestHarness:
+    """Build a one-op program, run it, and check outputs/gradients."""
+
+    def __init__(self, op_type, inputs, attrs=None, n_outputs=None,
+                 output_slots=None):
+        """inputs: {slot: np.ndarray | [np.ndarray, ...]}
+        output_slots: {slot: n_values} (default {"Out": 1})"""
+        self.op_type = op_type
+        self.attrs = attrs or {}
+        self.inputs = {k: (list(v) if isinstance(v, (list, tuple)) else [v])
+                       for k, v in inputs.items()}
+        self.output_slots = output_slots or {"Out": 1}
+        self._built = False
+
+    def _build(self, grad_inputs=()):
+        self.main = ptpu.Program()
+        self.startup = ptpu.Program()
+        with ptpu.program_guard(self.main, self.startup):
+            block = self.main.global_block()
+            in_names = {}
+            feed = {}
+            for slot, arrs in self.inputs.items():
+                names = []
+                for i, arr in enumerate(arrs):
+                    name = "in_%s_%d" % (slot, i)
+                    block.create_var(name=name, shape=arr.shape,
+                                     dtype=arr.dtype, stop_gradient=False)
+                    feed[name] = arr
+                    names.append(name)
+                in_names[slot] = names
+            out_names = {}
+            for slot, n in self.output_slots.items():
+                names = []
+                for i in range(n):
+                    name = "out_%s_%d" % (slot, i)
+                    block.create_var(name=name, dtype="float32")
+                    names.append(name)
+                out_names[slot] = names
+            block.append_op(self.op_type, inputs=in_names,
+                            outputs=out_names, attrs=self.attrs)
+            self.feed = feed
+            self.in_names = in_names
+            self.out_names = out_names
+            self.fetch_outputs = [n for ns in out_names.values() for n in ns]
+            if grad_inputs:
+                # L = sum over requested outputs of sum(out)
+                loss_terms = []
+                for name in grad_inputs["output_names"]:
+                    s = block.create_var(name=name + "_sum",
+                                         dtype="float32")
+                    block.append_op("reduce_sum", inputs={"X": [name]},
+                                    outputs={"Out": [s.name]},
+                                    attrs={"reduce_all": True})
+                    loss_terms.append(s.name)
+                if len(loss_terms) == 1:
+                    loss_name = loss_terms[0]
+                else:
+                    loss = block.create_var(name="loss_", dtype="float32")
+                    block.append_op("sum", inputs={"X": loss_terms},
+                                    outputs={"Out": [loss.name]})
+                    loss_name = loss.name
+                self.loss = block.var(loss_name)
+                self.p_g = append_backward(
+                    self.loss, parameter_list=grad_inputs["input_names"])
+        self.exe = ptpu.Executor()
+        self.scope = ptpu.Scope()
+
+    def run(self, extra_fetch=None, feed_override=None):
+        feed = dict(self.feed)
+        if feed_override:
+            feed.update(feed_override)
+        fetch = self.fetch_outputs + (extra_fetch or [])
+        with ptpu.scope_guard(self.scope):
+            if self.startup.global_block().ops:
+                self.exe.run(self.startup)
+            return self.exe.run(self.main, feed=feed, fetch_list=fetch)
+
+    # -- checks --------------------------------------------------------------
+    def check_output(self, expected, atol=1e-5, rtol=1e-5):
+        """expected: {slot: array | [arrays]}"""
+        self._build()
+        results = self.run()
+        got = dict(zip(self.fetch_outputs, results))
+        for slot, exp in expected.items():
+            exps = list(exp) if isinstance(exp, (list, tuple)) else [exp]
+            for i, e in enumerate(exps):
+                g = got["out_%s_%d" % (slot, i)]
+                np.testing.assert_allclose(
+                    g, e, atol=atol, rtol=rtol,
+                    err_msg="op %s output %s[%d]" % (self.op_type, slot, i))
+        return got
+
+    def check_grad(self, inputs_to_check, output_names=None, delta=5e-3,
+                   max_relative_error=0.005):
+        """Central-difference vs analytic gradient (reference
+        get_numeric_gradient / check_grad)."""
+        self._build()
+        all_out = [n for ns in self.out_names.values() for n in ns]
+        if output_names is None:
+            output_names = all_out
+        input_names = []
+        for slot_i in inputs_to_check:
+            slot, i = (slot_i, 0) if isinstance(slot_i, str) else slot_i
+            input_names.append("in_%s_%d" % (slot, i))
+        self._build(grad_inputs={"input_names": input_names,
+                                 "output_names": output_names})
+        grad_by_param = {p.name: g.name for p, g in self.p_g}
+        grad_names = [grad_by_param[n] for n in input_names]
+        with ptpu.scope_guard(self.scope):
+            if self.startup.global_block().ops:
+                self.exe.run(self.startup)
+            analytic = self.exe.run(self.main, feed=self.feed,
+                                    fetch_list=grad_names)
+
+        for name, ag in zip(input_names, analytic):
+            base = self.feed[name].astype(np.float64)
+            numeric = np.zeros_like(base).reshape(-1)
+            flat = base.reshape(-1)
+            for j in range(flat.size):
+                for sgn in (+1, -1):
+                    pert = flat.copy()
+                    pert[j] += sgn * delta
+                    feed = {name: pert.reshape(base.shape).astype(
+                        self.feed[name].dtype)}
+                    outs = self.run(extra_fetch=None, feed_override=feed)
+                    got = dict(zip(self.fetch_outputs, outs))
+                    val = sum(float(np.sum(got[o])) for o in output_names)
+                    numeric[j] += sgn * val
+            numeric = (numeric / (2.0 * delta)).reshape(base.shape)
+            ag = np.asarray(ag, dtype=np.float64)
+            abs_err = np.abs(ag - numeric)
+            denom = np.maximum(np.maximum(np.abs(ag), np.abs(numeric)), 1.0)
+            rel = (abs_err / denom).max()
+            assert rel <= max_relative_error, (
+                "op %s: gradient wrt %s mismatch: max rel err %.3e\n"
+                "analytic:\n%s\nnumeric:\n%s"
+                % (self.op_type, name, rel, ag, numeric))

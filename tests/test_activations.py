@@ -1,0 +1,87 @@
+"""Activation op tests vs numpy formulas + gradient checks
+(reference activation_op tests, SURVEY A.1/A.3)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTestHarness
+
+RS = np.random.RandomState(7)
+
+
+def _x(name="x"):
+    # deterministic per-op draw, away from kinks for numeric grad stability
+    seed = sum(ord(c) for c in name) * 131 + 7
+    return np.random.RandomState(seed).uniform(
+        0.2, 0.9, (3, 4)).astype("float32")
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+FORMULAS = {
+    "sigmoid": sigmoid,
+    "logsigmoid": lambda x: np.log(sigmoid(x)),
+    "exp": np.exp,
+    "relu": lambda x: np.maximum(x, 0),
+    "tanh": np.tanh,
+    "tanh_shrink": lambda x: x - np.tanh(x),
+    "sqrt": np.sqrt,
+    "abs": np.abs,
+    "reciprocal": lambda x: 1.0 / x,
+    "log": np.log,
+    "square": np.square,
+    "softplus": lambda x: np.log1p(np.exp(x)),
+    "softsign": lambda x: x / (1 + np.abs(x)),
+    "brelu": lambda x: np.clip(x, 0.0, 24.0),
+    "leaky_relu": lambda x: np.where(x >= 0, x, 0.02 * x),
+    "elu": lambda x: np.where(x >= 0, x, np.exp(x) - 1),
+    "relu6": lambda x: np.clip(x, 0, 6),
+    "stanh": lambda x: 1.7159 * np.tanh(2.0 / 3.0 * x),
+    "hard_sigmoid": lambda x: np.clip(0.2 * x + 0.5, 0, 1),
+    "swish": lambda x: x * sigmoid(x),
+    "softshrink": lambda x: np.where(x > 0.5, x - 0.5,
+                                     np.where(x < -0.5, x + 0.5, 0)),
+    "hard_shrink": lambda x: np.where(np.abs(x) > 0.5, x, 0),
+    "thresholded_relu": lambda x: np.where(x > 1.0, x, 0),
+    "ceil": np.ceil, "floor": np.floor, "round": np.round,
+    "sign": np.sign,
+}
+
+SMOOTH = ["sigmoid", "tanh", "exp", "softplus", "softsign", "square",
+          "stanh", "swish", "logsigmoid"]
+
+
+@pytest.mark.parametrize("name", sorted(FORMULAS))
+def test_activation_output(name):
+    x = _x(name)
+    OpTestHarness(name, {"X": x}).check_output({"Out": FORMULAS[name](x)},
+                                               rtol=2e-3, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", SMOOTH)
+def test_activation_grad(name):
+    x = _x(name + "_grad")
+    OpTestHarness(name, {"X": x}).check_grad([("X", 0)],
+                                             max_relative_error=0.02)
+
+
+def test_softmax():
+    x = RS.randn(4, 7).astype("float32")
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    OpTestHarness("softmax", {"X": x}).check_output(
+        {"Out": e / e.sum(axis=1, keepdims=True)}, rtol=1e-4)
+
+
+def test_softmax_grad():
+    x = RS.randn(3, 5).astype("float32")
+    OpTestHarness("softmax", {"X": x}).check_grad([("X", 0)],
+                                                  max_relative_error=0.01)
+
+
+def test_prelu():
+    x = RS.randn(3, 4).astype("float32")
+    alpha = np.array([0.25], dtype="float32")
+    OpTestHarness("prelu", {"X": x, "Alpha": alpha}).check_output(
+        {"Out": np.where(x >= 0, x, 0.25 * x)})

@@ -1,0 +1,48 @@
+"""Book test 1: fit_a_line linear regression to convergence
+(reference ``fluid/tests/book/test_fit_a_line.py``; config #1 family)."""
+
+import numpy as np
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+
+
+def test_fit_a_line_converges():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[13])
+        y = layers.data("y", shape=[1])
+        y_predict = layers.fc(x, size=1)
+        cost = layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = layers.mean(cost)
+        sgd = ptpu.optimizer.SGD(learning_rate=0.05)
+        sgd.minimize(avg_cost, startup_program=startup)
+
+    exe = ptpu.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    w_true = rs.randn(13, 1).astype("float32")
+    losses = []
+    for i in range(300):
+        xb = rs.randn(32, 13).astype("float32")
+        yb = xb @ w_true + 0.3
+        out, = exe.run(main, feed={"x": xb, "y": yb},
+                       fetch_list=[avg_cost])
+        losses.append(float(out))
+    assert losses[-1] < 1e-3, losses[-1]
+
+
+def test_fit_a_line_infer_matches_weights():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y_predict = layers.fc(x, size=1,
+                              param_attr=ptpu.ParamAttr(name="fc.w"),
+                              bias_attr=ptpu.ParamAttr(name="fc.b"))
+    exe = ptpu.Executor()
+    exe.run(startup)
+    w = np.asarray(ptpu.global_scope().find_var("fc.w"))
+    b = np.asarray(ptpu.global_scope().find_var("fc.b"))
+    xb = np.random.RandomState(1).randn(8, 4).astype("float32")
+    out, = exe.run(main, feed={"x": xb}, fetch_list=[y_predict])
+    np.testing.assert_allclose(out, xb @ w + b, rtol=1e-4, atol=1e-5)

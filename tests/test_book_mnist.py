@@ -1,0 +1,97 @@
+"""Book test 2: MNIST digit recognition — MLP and LeNet-5 conv net trained
+on a synthetic separable digit task (reference
+``fluid/tests/book/test_recognize_digits_{mlp,conv}.py``; BASELINE config #1:
+MNIST LeNet-5). Uses synthetic data (zero-egress image) with the real model
+architecture; convergence thresholds mirror the reference's book tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers, nets
+
+
+def synth_digits(n, rs, img_shape=(1, 28, 28), n_classes=10):
+    """Separable synthetic digits: class-dependent blob positions."""
+    y = rs.randint(0, n_classes, size=n)
+    x = rs.randn(n, *img_shape).astype("float32") * 0.3
+    for i in range(n):
+        c = y[i]
+        r0, c0 = 2 + (c // 5) * 12, 2 + (c % 5) * 5
+        x[i, 0, r0:r0 + 6, c0:c0 + 4] += 2.0
+    return x, y.astype("int64").reshape(-1, 1)
+
+
+def _train(main, startup, loss, acc, steps=40, bs=64, lr_feed=None):
+    exe = ptpu.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    accs = []
+    for i in range(steps):
+        xb, yb = synth_digits(bs, rs)
+        lv, av = exe.run(main, feed={"img": xb, "label": yb},
+                         fetch_list=[loss, acc])
+        accs.append(float(av))
+    return accs
+
+
+def test_mnist_mlp():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        img = layers.data("img", shape=[1, 28, 28])
+        label = layers.data("label", shape=[1], dtype="int64")
+        flat = layers.reshape(img, [-1, 784])
+        h1 = layers.fc(flat, 128, act="relu")
+        h2 = layers.fc(h1, 64, act="relu")
+        logits = layers.fc(h2, 10)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        opt = ptpu.optimizer.Adam(learning_rate=1e-3)
+        opt.minimize(loss, startup_program=startup)
+    accs = _train(main, startup, loss, acc, steps=60)
+    assert np.mean(accs[-10:]) > 0.95, accs[-10:]
+
+
+def test_mnist_lenet5_conv():
+    """LeNet-5: conv-pool x2 + fc, the BASELINE config #1 architecture."""
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        img = layers.data("img", shape=[1, 28, 28])
+        label = layers.data("label", shape=[1], dtype="int64")
+        conv1 = nets.simple_img_conv_pool(img, num_filters=20,
+                                          filter_size=5, pool_size=2,
+                                          pool_stride=2, act="relu")
+        conv2 = nets.simple_img_conv_pool(conv1, num_filters=50,
+                                          filter_size=5, pool_size=2,
+                                          pool_stride=2, act="relu")
+        flat = layers.reshape(conv2, [-1, 50 * 4 * 4])
+        logits = layers.fc(flat, 10)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        opt = ptpu.optimizer.Adam(learning_rate=1e-3)
+        opt.minimize(loss, startup_program=startup)
+    accs = _train(main, startup, loss, acc, steps=50)
+    assert np.mean(accs[-10:]) > 0.9, accs[-10:]
+
+
+def test_mnist_conv_with_batchnorm_dropout():
+    """Exercises BN state updates + dropout RNG inside the train step."""
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        img = layers.data("img", shape=[1, 28, 28])
+        label = layers.data("label", shape=[1], dtype="int64")
+        c1 = layers.conv2d(img, 16, 5, padding=2, act=None)
+        b1 = layers.batch_norm(c1, act="relu")
+        p1 = layers.pool2d(b1, 2, "max", 2)
+        flat = layers.reshape(p1, [-1, 16 * 14 * 14])
+        d = layers.dropout(flat, dropout_prob=0.3)
+        logits = layers.fc(d, 10)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        opt = ptpu.optimizer.Adam(learning_rate=1e-3)
+        opt.minimize(loss, startup_program=startup)
+    accs = _train(main, startup, loss, acc, steps=50)
+    assert np.mean(accs[-10:]) > 0.85, accs[-10:]

@@ -1,0 +1,108 @@
+"""Executor semantics: scope persistence, jit-cache reuse, rng state
+threading, fetch, program isolation (reference test_executor /
+framework tests)."""
+
+import numpy as np
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+from paddle_tpu.core.framework import RNG_STATE_VAR
+
+
+def test_persistable_state_survives_runs():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        counter = main.global_block().create_var(
+            name="counter", shape=[1], dtype="float32", persistable=True,
+            stop_gradient=True)
+        svar = startup.global_block().create_var(
+            name="counter", shape=[1], dtype="float32", persistable=True)
+        ptpu.initializer.Constant(0.0)(svar, startup.global_block())
+        main.global_block().append_op(
+            "increment", inputs={"X": ["counter"]},
+            outputs={"Out": ["counter"]}, attrs={"step": 1.0},
+            infer_shape=False)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    for i in range(5):
+        exe.run(main)
+    val = np.asarray(ptpu.global_scope().find_var("counter"))
+    np.testing.assert_allclose(val, [5.0])
+
+
+def test_rng_state_advances():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        d = layers.data("x", shape=[100])
+        out = layers.dropout(d, dropout_prob=0.5)
+    exe = ptpu.Executor()
+    x = np.ones((1, 100), dtype="float32")
+    a, = exe.run(main, feed={"x": x}, fetch_list=[out])
+    b, = exe.run(main, feed={"x": x}, fetch_list=[out])
+    assert not np.array_equal(a, b), "dropout masks must differ across runs"
+    assert ptpu.global_scope().has_var(RNG_STATE_VAR)
+
+
+def test_rng_seed_reproducible():
+    def run_once():
+        main, startup = ptpu.Program(), ptpu.Program()
+        main.random_seed = 42
+        with ptpu.program_guard(main, startup):
+            d = layers.data("x", shape=[50])
+            out = layers.dropout(d, dropout_prob=0.5)
+        with ptpu.scope_guard(ptpu.Scope()):
+            exe = ptpu.Executor()
+            a, = exe.run(main, feed={"x": np.ones((1, 50), "float32")},
+                         fetch_list=[out])
+        return a
+    np.testing.assert_array_equal(run_once(), run_once())
+
+
+def test_fetch_multiple_and_feed_shapes_respecialize():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.scale(x, scale=2.0)
+        z = layers.scale(y, scale=3.0)
+    exe = ptpu.Executor()
+    for bs in (2, 8, 3):
+        xv = np.ones((bs, 4), dtype="float32")
+        yv, zv = exe.run(main, feed={"x": xv}, fetch_list=[y, z])
+        assert yv.shape == (bs, 4)
+        np.testing.assert_allclose(zv, 6 * xv)
+
+
+def test_two_programs_share_scope_params():
+    """Train program and test program (is_test views) share parameters via
+    the scope — the reference's train/test program pattern."""
+    main, startup = ptpu.Program(), ptpu.Program()
+    test_prog = ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        h = layers.fc(x, 3, param_attr=ptpu.ParamAttr(name="w"),
+                      bias_attr=False)
+    with ptpu.program_guard(test_prog, startup):
+        x2 = layers.data("x", shape=[4])
+        h2 = layers.fc(x2, 3, param_attr=ptpu.ParamAttr(name="w"),
+                       bias_attr=False)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(2, 4).astype("float32")
+    a, = exe.run(main, feed={"x": xv}, fetch_list=[h])
+    b, = exe.run(test_prog, feed={"x": xv}, fetch_list=[h2])
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_uninitialized_param_raises():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        h = layers.fc(x, 3)
+    exe = ptpu.Executor()
+    try:
+        exe.run(main, feed={"x": np.ones((1, 4), "float32")},
+                fetch_list=[h])
+    except RuntimeError as e:
+        assert "startup" in str(e)
+    else:
+        raise AssertionError("expected RuntimeError for missing init")

@@ -1,0 +1,165 @@
+"""Loss op tests (reference loss-op OpTests)."""
+
+import numpy as np
+
+from op_test import OpTestHarness
+
+def RSn(seed):
+    return np.random.RandomState(seed)
+
+
+class _RSProxy:
+    """Stable draws regardless of test execution order: one RandomState per
+    calling test function, seeded by its name."""
+
+    _states = {}
+
+    def __getattr__(self, name):
+        import inspect
+        caller = inspect.stack()[1].function
+        if caller not in self._states:
+            seed = sum(ord(c) for c in caller) % 9973
+            self._states[caller] = np.random.RandomState(seed)
+        return getattr(self._states[caller], name)
+
+
+RS = _RSProxy()
+
+
+def softmax_np(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def test_cross_entropy_hard():
+    probs = softmax_np(RS.randn(4, 5).astype("float32"))
+    label = np.array([[0], [2], [4], [1]], dtype="int64")
+    expect = -np.log(probs[np.arange(4), label.ravel()]).reshape(4, 1)
+    OpTestHarness("cross_entropy", {"X": probs, "Label": label},
+                  output_slots={"Y": 1}).check_output({"Y": expect},
+                                                      rtol=1e-3, atol=1e-6)
+
+
+def test_cross_entropy_soft():
+    probs = softmax_np(RS.randn(4, 5).astype("float32"))
+    soft = softmax_np(RS.randn(4, 5).astype("float32"))
+    expect = -(soft * np.log(probs)).sum(axis=1, keepdims=True)
+    OpTestHarness("cross_entropy", {"X": probs, "Label": soft},
+                  attrs={"soft_label": True},
+                  output_slots={"Y": 1}).check_output({"Y": expect},
+                                                      rtol=1e-3, atol=1e-6)
+
+
+def test_softmax_with_cross_entropy():
+    logits = RS.randn(4, 6).astype("float32")
+    label = np.array([[1], [0], [5], [3]], dtype="int64")
+    sm = softmax_np(logits)
+    expect = -np.log(sm[np.arange(4), label.ravel()]).reshape(4, 1)
+    t = OpTestHarness("softmax_with_cross_entropy",
+                      {"Logits": logits, "Label": label},
+                      output_slots={"Softmax": 1, "Loss": 1})
+    t.check_output({"Softmax": sm, "Loss": expect}, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_with_cross_entropy_grad():
+    logits = RS.randn(3, 5).astype("float32")
+    label = np.array([[1], [0], [4]], dtype="int64")
+    t = OpTestHarness("softmax_with_cross_entropy",
+                      {"Logits": logits, "Label": label},
+                      output_slots={"Softmax": 1, "Loss": 1})
+    t.check_grad([("Logits", 0)], output_names=["out_Loss_0"],
+                 max_relative_error=0.02)
+
+
+def test_sigmoid_cross_entropy_with_logits():
+    x = RS.randn(4, 3).astype("float32")
+    label = RS.uniform(0, 1, (4, 3)).astype("float32")
+    sig = 1 / (1 + np.exp(-x))
+    expect = -label * np.log(sig) - (1 - label) * np.log(1 - sig)
+    OpTestHarness("sigmoid_cross_entropy_with_logits",
+                  {"X": x, "Label": label}).check_output(
+        {"Out": expect}, rtol=1e-3, atol=1e-5)
+
+
+def test_square_error_and_grads():
+    x, y = RS.randn(4, 3).astype("float32"), RS.randn(4, 3).astype("float32")
+    t = OpTestHarness("square_error_cost", {"X": x, "Y": y})
+    t.check_output({"Out": (x - y) ** 2}, rtol=1e-3, atol=1e-6)
+    t.check_grad([("X", 0)])
+
+
+def test_huber_loss():
+    x = RS.randn(5, 1).astype("float32")
+    y = RS.randn(5, 1).astype("float32")
+    r = y - x
+    expect = np.where(np.abs(r) <= 1.0, 0.5 * r ** 2, np.abs(r) - 0.5)
+    OpTestHarness("huber_loss", {"X": x, "Y": y}, attrs={"delta": 1.0},
+                  output_slots={"Out": 1, "Residual": 1}).check_output(
+        {"Out": expect}, rtol=1e-3, atol=1e-6)
+
+
+def test_log_loss():
+    p = RS.uniform(0.1, 0.9, (5, 1)).astype("float32")
+    y = (RS.uniform(0, 1, (5, 1)) > 0.5).astype("float32")
+    eps = 1e-4
+    expect = -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+    OpTestHarness("log_loss", {"Predicted": p, "Labels": y},
+                  attrs={"epsilon": eps},
+                  output_slots={"Loss": 1}).check_output({"Loss": expect},
+                                                         rtol=1e-3, atol=1e-6)
+
+
+def test_hinge_loss():
+    logits = RS.randn(6, 1).astype("float32")
+    label = (RS.uniform(0, 1, (6, 1)) > 0.5).astype("float32")
+    expect = np.maximum(0, 1 - (2 * label - 1) * logits)
+    OpTestHarness("hinge_loss", {"Logits": logits, "Labels": label},
+                  output_slots={"Loss": 1}).check_output({"Loss": expect},
+                                                         rtol=1e-3, atol=1e-6)
+
+
+def test_rank_loss():
+    left = RS.randn(5, 1).astype("float32")
+    right = RS.randn(5, 1).astype("float32")
+    label = (RS.uniform(0, 1, (5, 1)) > 0.5).astype("float32")
+    d = left - right
+    expect = np.log1p(np.exp(d)) - label * d
+    OpTestHarness("rank_loss", {"Left": left, "Right": right,
+                                "Label": label}).check_output(
+        {"Out": expect}, rtol=1e-3)
+
+
+def test_smooth_l1():
+    x = RS.randn(4, 3).astype("float32")
+    y = RS.randn(4, 3).astype("float32")
+    d = x - y
+    val = np.where(np.abs(d) < 1.0, 0.5 * d ** 2, np.abs(d) - 0.5)
+    expect = val.sum(axis=1, keepdims=True)
+    OpTestHarness("smooth_l1_loss", {"X": x, "Y": y},
+                  attrs={"sigma": 1.0},
+                  output_slots={"Out": 1, "Diff": 1}).check_output(
+        {"Out": expect}, rtol=1e-3, atol=1e-5)
+
+
+def test_hsigmoid_shapes_and_grad():
+    x = RS.randn(4, 8).astype("float32")
+    w = RS.randn(9, 8).astype("float32") * 0.1
+    label = np.array([[0], [3], [7], [9]], dtype="int64")
+    t = OpTestHarness("hsigmoid", {"X": x, "W": w, "Label": label},
+                      attrs={"num_classes": 10})
+    t._build()
+    out, = t.run()
+    assert out.shape == (4, 1)
+    assert (out > 0).all()
+    t.check_grad([("X", 0), ("W", 0)], max_relative_error=0.02)
+
+
+def test_accuracy_op():
+    idx = np.array([[0, 1], [2, 3], [4, 5]], dtype="int64")
+    label = np.array([[1], [0], [4]], dtype="int64")
+    t = OpTestHarness("accuracy", {"Indices": idx, "Label": label},
+                      output_slots={"Accuracy": 1, "Correct": 1,
+                                    "Total": 1})
+    got = t.check_output({"Accuracy": np.float32(2.0 / 3.0)}, rtol=1e-6)
+    assert int(got["out_Correct_0"]) == 2
+    assert int(got["out_Total_0"]) == 3

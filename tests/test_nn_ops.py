@@ -1,0 +1,209 @@
+"""conv/pool/batch_norm/dropout/lrn op tests vs naive numpy references
+(reference conv/pool/batch_norm op tests — SURVEY §4 CPU-vs-device compare)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTestHarness
+
+RS = np.random.RandomState(3)
+
+
+def naive_conv2d(x, w, stride, pad):
+    n, cin, h, wd = x.shape
+    cout, _, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, cout, oh, ow), dtype=np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("ncij,ocij->no", patch, w)
+    return out
+
+
+class TestConv:
+    def test_conv2d_basic(self):
+        x = RS.randn(2, 3, 8, 8).astype("float32")
+        w = RS.randn(4, 3, 3, 3).astype("float32")
+        expect = naive_conv2d(x, w, 1, 1)
+        t = OpTestHarness("conv2d", {"Input": x, "Filter": w},
+                          attrs={"strides": [1, 1], "paddings": [1, 1]},
+                          output_slots={"Output": 1})
+        t.check_output({"Output": expect.astype("float32")}, rtol=1e-3,
+                       atol=1e-4)
+
+    def test_conv2d_stride2(self):
+        x = RS.randn(1, 2, 7, 7).astype("float32")
+        w = RS.randn(3, 2, 3, 3).astype("float32")
+        expect = naive_conv2d(x, w, 2, 0)
+        t = OpTestHarness("conv2d", {"Input": x, "Filter": w},
+                          attrs={"strides": [2, 2], "paddings": [0, 0]},
+                          output_slots={"Output": 1})
+        t.check_output({"Output": expect.astype("float32")}, rtol=1e-3,
+                       atol=1e-4)
+
+    def test_conv2d_grad(self):
+        x = RS.randn(1, 2, 5, 5).astype("float32")
+        w = RS.randn(2, 2, 3, 3).astype("float32")
+        t = OpTestHarness("conv2d", {"Input": x, "Filter": w},
+                          attrs={"strides": [1, 1], "paddings": [1, 1]},
+                          output_slots={"Output": 1})
+        t.check_grad([("Input", 0), ("Filter", 0)],
+                     output_names=["out_Output_0"],
+                     max_relative_error=0.02)
+
+    def test_conv2d_transpose_shape(self):
+        x = RS.randn(1, 3, 4, 4).astype("float32")
+        w = RS.randn(3, 5, 3, 3).astype("float32")  # [in, out, kh, kw]
+        t = OpTestHarness("conv2d_transpose", {"Input": x, "Filter": w},
+                          attrs={"strides": [2, 2], "paddings": [0, 0]},
+                          output_slots={"Output": 1})
+        t._build()
+        out, = t.run()
+        assert out.shape == (1, 5, 9, 9)
+
+
+class TestPool:
+    def test_max_pool(self):
+        x = RS.randn(2, 3, 6, 6).astype("float32")
+        expect = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+        OpTestHarness("pool2d", {"X": x},
+                      attrs={"ksize": [2, 2], "strides": [2, 2],
+                             "paddings": [0, 0],
+                             "pooling_type": "max"}).check_output(
+            {"Out": expect})
+
+    def test_avg_pool(self):
+        x = RS.randn(2, 3, 6, 6).astype("float32")
+        expect = x.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5))
+        OpTestHarness("pool2d", {"X": x},
+                      attrs={"ksize": [2, 2], "strides": [2, 2],
+                             "paddings": [0, 0],
+                             "pooling_type": "avg"}).check_output(
+            {"Out": expect}, rtol=1e-5)
+
+    def test_global_pool(self):
+        x = RS.randn(2, 3, 5, 5).astype("float32")
+        OpTestHarness("pool2d", {"X": x},
+                      attrs={"ksize": [1, 1], "strides": [1, 1],
+                             "paddings": [0, 0], "pooling_type": "avg",
+                             "global_pooling": True}).check_output(
+            {"Out": x.mean(axis=(2, 3), keepdims=True)}, rtol=1e-5)
+
+    def test_pool_grad(self):
+        x = RS.randn(1, 2, 4, 4).astype("float32")
+        OpTestHarness("pool2d", {"X": x},
+                      attrs={"ksize": [2, 2], "strides": [2, 2],
+                             "paddings": [0, 0],
+                             "pooling_type": "avg"}).check_grad(
+            [("X", 0)])
+
+
+class TestBatchNorm:
+    def test_train_stats(self):
+        x = RS.randn(4, 3, 5, 5).astype("float32")
+        scale = np.ones(3, dtype="float32") * 1.5
+        bias = np.zeros(3, dtype="float32") + 0.2
+        mean = np.zeros(3, dtype="float32")
+        var = np.ones(3, dtype="float32")
+        mu = x.mean(axis=(0, 2, 3))
+        v = x.var(axis=(0, 2, 3))
+        expect = (x - mu.reshape(1, 3, 1, 1)) / np.sqrt(
+            v.reshape(1, 3, 1, 1) + 1e-5) * 1.5 + 0.2
+        t = OpTestHarness("batch_norm",
+                          {"X": x, "Scale": scale, "Bias": bias,
+                           "Mean": mean, "Variance": var},
+                          attrs={"momentum": 0.9, "epsilon": 1e-5,
+                                 "is_test": False},
+                          output_slots={"Y": 1, "MeanOut": 1,
+                                        "VarianceOut": 1, "SavedMean": 1,
+                                        "SavedVariance": 1})
+        got = t.check_output({"Y": expect,
+                              "MeanOut": 0.9 * mean + 0.1 * mu},
+                             rtol=1e-3, atol=1e-4)
+
+    def test_inference_mode(self):
+        x = RS.randn(4, 3, 2, 2).astype("float32")
+        scale = np.ones(3, dtype="float32")
+        bias = np.zeros(3, dtype="float32")
+        mean = RS.randn(3).astype("float32") * 0.1
+        var = np.abs(RS.randn(3).astype("float32")) + 0.5
+        expect = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+            var.reshape(1, 3, 1, 1) + 1e-5)
+        OpTestHarness("batch_norm",
+                      {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var},
+                      attrs={"is_test": True},
+                      output_slots={"Y": 1, "MeanOut": 1, "VarianceOut": 1,
+                                    "SavedMean": 1, "SavedVariance": 1}
+                      ).check_output({"Y": expect, "MeanOut": mean,
+                                      "VarianceOut": var},
+                                     rtol=1e-3, atol=1e-4)
+
+    def test_grad(self):
+        x = RS.randn(3, 2, 3, 3).astype("float32")
+        scale = np.array([1.2, 0.8], dtype="float32")
+        bias = np.array([0.1, -0.1], dtype="float32")
+        mean = np.zeros(2, dtype="float32")
+        var = np.ones(2, dtype="float32")
+        t = OpTestHarness("batch_norm",
+                          {"X": x, "Scale": scale, "Bias": bias,
+                           "Mean": mean, "Variance": var},
+                          attrs={"is_test": False},
+                          output_slots={"Y": 1, "MeanOut": 1,
+                                        "VarianceOut": 1, "SavedMean": 1,
+                                        "SavedVariance": 1})
+        t.check_grad([("X", 0), ("Scale", 0), ("Bias", 0)],
+                     output_names=["out_Y_0"], max_relative_error=0.02)
+
+
+class TestLayerNorm:
+    def test_output(self):
+        x = RS.randn(4, 6).astype("float32")
+        mu = x.mean(axis=1, keepdims=True)
+        v = x.var(axis=1, keepdims=True)
+        expect = (x - mu) / np.sqrt(v + 1e-5)
+        OpTestHarness("layer_norm", {"X": x},
+                      attrs={"begin_norm_axis": 1},
+                      output_slots={"Y": 1, "Mean": 1, "Variance": 1}
+                      ).check_output({"Y": expect}, rtol=1e-3, atol=1e-4)
+
+
+class TestLrnDropout:
+    def test_lrn(self):
+        x = RS.randn(2, 8, 3, 3).astype("float32")
+        sq = np.square(x)
+        pad = np.pad(sq, ((0, 0), (2, 2), (0, 0), (0, 0)))
+        acc = sum(pad[:, i:i + 8] for i in range(5))
+        expect = x / np.power(2.0 + 1e-4 * acc, 0.75)
+        OpTestHarness("lrn", {"X": x},
+                      attrs={"n": 5, "k": 2.0, "alpha": 1e-4, "beta": 0.75},
+                      output_slots={"Out": 1, "MidOut": 1}).check_output(
+            {"Out": expect}, rtol=1e-4, atol=1e-5)
+
+    def test_dropout_train_stats(self):
+        x = np.ones((64, 64), dtype="float32")
+        t = OpTestHarness("dropout", {"X": x},
+                          attrs={"dropout_prob": 0.3},
+                          output_slots={"Out": 1, "Mask": 1})
+        t._build()
+        out, mask = t.run()
+        keep = float((out != 0).mean())
+        assert abs(keep - 0.7) < 0.05
+        assert set(np.unique(out)) <= {0.0, 1.0}
+
+    def test_dropout_test_mode(self):
+        x = RS.randn(8, 8).astype("float32")
+        OpTestHarness("dropout", {"X": x},
+                      attrs={"dropout_prob": 0.3, "is_test": True},
+                      output_slots={"Out": 1, "Mask": 1}).check_output(
+            {"Out": x * 0.7}, rtol=1e-5)
+
+    def test_maxout(self):
+        x = RS.randn(2, 6, 3, 3).astype("float32")
+        expect = x.reshape(2, 3, 2, 3, 3).max(axis=2)
+        OpTestHarness("maxout", {"X": x},
+                      attrs={"groups": 2}).check_output({"Out": expect})

@@ -1,0 +1,216 @@
+"""Math/elementwise/reduce op tests: outputs vs numpy, grads vs central
+difference (reference OpTest pattern, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTestHarness
+
+RS = np.random.RandomState(42)
+
+
+def _f(*shape):
+    return RS.uniform(0.1, 1.0, shape).astype("float32")
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("op,fn", [
+        ("elementwise_add", np.add), ("elementwise_sub", np.subtract),
+        ("elementwise_mul", np.multiply), ("elementwise_div", np.divide),
+        ("elementwise_max", np.maximum), ("elementwise_min", np.minimum)])
+    def test_output(self, op, fn):
+        x, y = _f(3, 4), _f(3, 4)
+        OpTestHarness(op, {"X": x, "Y": y}).check_output({"Out": fn(x, y)})
+
+    def test_broadcast_axis(self):
+        x, y = _f(2, 3, 4), _f(3)
+        t = OpTestHarness("elementwise_add", {"X": x, "Y": y},
+                          attrs={"axis": 1})
+        t.check_output({"Out": x + y.reshape(1, 3, 1)})
+
+    @pytest.mark.parametrize("op", ["elementwise_add", "elementwise_mul",
+                                    "elementwise_div"])
+    def test_grad(self, op):
+        x, y = _f(3, 4), _f(3, 4)
+        t = OpTestHarness(op, {"X": x, "Y": y})
+        t.check_grad([("X", 0), ("Y", 0)])
+
+
+class TestMatmul:
+    def test_mul(self):
+        x, y = _f(3, 4), _f(4, 5)
+        OpTestHarness("mul", {"X": x, "Y": y}).check_output({"Out": x @ y})
+
+    def test_mul_flatten(self):
+        x, y = _f(2, 3, 4), _f(12, 5)
+        t = OpTestHarness("mul", {"X": x, "Y": y},
+                          attrs={"x_num_col_dims": 1})
+        t.check_output({"Out": x.reshape(2, 12) @ y})
+
+    def test_matmul_transpose(self):
+        x, y = _f(4, 3), _f(5, 4)
+        t = OpTestHarness("matmul", {"X": x, "Y": y},
+                          attrs={"transpose_X": True, "transpose_Y": True})
+        t.check_output({"Out": x.T @ y.T})
+
+    def test_matmul_grad(self):
+        x, y = _f(3, 4), _f(4, 5)
+        OpTestHarness("matmul", {"X": x, "Y": y}).check_grad(
+            [("X", 0), ("Y", 0)])
+
+    def test_batched_matmul(self):
+        x, y = _f(2, 3, 4), _f(2, 4, 5)
+        OpTestHarness("matmul", {"X": x, "Y": y}).check_output(
+            {"Out": np.matmul(x, y)})
+
+
+class TestReduce:
+    def test_sum_all(self):
+        x = _f(3, 4)
+        OpTestHarness("reduce_sum", {"X": x},
+                      attrs={"reduce_all": True}).check_output(
+            {"Out": np.sum(x)})
+
+    def test_mean_dim(self):
+        x = _f(3, 4, 5)
+        t = OpTestHarness("reduce_mean", {"X": x},
+                          attrs={"dim": 1, "keep_dim": True})
+        t.check_output({"Out": x.mean(axis=1, keepdims=True)})
+
+    def test_max_grad(self):
+        x = RS.permutation(12).astype("float32").reshape(3, 4)
+        OpTestHarness("reduce_max", {"X": x},
+                      attrs={"reduce_all": True}).check_grad([("X", 0)])
+
+    def test_sum_grad(self):
+        OpTestHarness("reduce_sum", {"X": _f(3, 4)},
+                      attrs={"dim": 0}).check_grad([("X", 0)])
+
+
+class TestMisc:
+    def test_sum_op(self):
+        xs = [_f(3, 4) for _ in range(3)]
+        OpTestHarness("sum", {"X": xs}).check_output(
+            {"Out": xs[0] + xs[1] + xs[2]})
+
+    def test_mean(self):
+        x = _f(5, 6)
+        t = OpTestHarness("mean", {"X": x})
+        t.check_output({"Out": np.mean(x)})
+        t.check_grad([("X", 0)])
+
+    def test_scale(self):
+        x = _f(3, 4)
+        OpTestHarness("scale", {"X": x},
+                      attrs={"scale": 2.5, "bias": 0.5}).check_output(
+            {"Out": 2.5 * x + 0.5})
+
+    def test_clip(self):
+        x = (_f(4, 4) - 0.5) * 4
+        OpTestHarness("clip", {"X": x},
+                      attrs={"min": -0.5, "max": 0.5}).check_output(
+            {"Out": np.clip(x, -0.5, 0.5)})
+
+    def test_clip_by_norm(self):
+        x = _f(4, 4) * 10
+        norm = np.sqrt((x ** 2).sum())
+        OpTestHarness("clip_by_norm", {"X": x},
+                      attrs={"max_norm": 1.0}).check_output(
+            {"Out": x / norm}, rtol=1e-4)
+
+    def test_squared_l2_norm(self):
+        x = _f(3, 4)
+        t = OpTestHarness("squared_l2_norm", {"X": x})
+        t.check_output({"Out": np.sum(x ** 2)})
+        t.check_grad([("X", 0)])
+
+    def test_cos_sim(self):
+        x, y = _f(4, 8), _f(4, 8)
+        expect = (x * y).sum(1, keepdims=True) / (
+            np.linalg.norm(x, axis=1, keepdims=True) *
+            np.linalg.norm(y, axis=1, keepdims=True) + 1e-12)
+        t = OpTestHarness("cos_sim", {"X": x, "Y": y},
+                          output_slots={"Out": 1, "XNorm": 1, "YNorm": 1})
+        t.check_output({"Out": expect}, rtol=1e-4)
+
+    def test_top_k(self):
+        x = RS.randn(4, 10).astype("float32")
+        t = OpTestHarness("top_k", {"X": x}, attrs={"k": 3},
+                          output_slots={"Out": 1, "Indices": 1})
+        expect_idx = np.argsort(-x, axis=1)[:, :3]
+        expect_val = np.take_along_axis(x, expect_idx, axis=1)
+        t.check_output({"Out": expect_val, "Indices": expect_idx})
+
+    def test_compare_ops(self):
+        x, y = _f(3, 4), _f(3, 4)
+        OpTestHarness("less_than", {"X": x, "Y": y}).check_output(
+            {"Out": x < y})
+        OpTestHarness("equal", {"X": x, "Y": x}).check_output(
+            {"Out": np.ones_like(x, dtype=bool)})
+
+
+class TestTensorOps:
+    def test_concat_split(self):
+        xs = [_f(2, 3), _f(2, 4)]
+        OpTestHarness("concat", {"X": xs}, attrs={"axis": 1}).check_output(
+            {"Out": np.concatenate(xs, axis=1)})
+        x = _f(2, 6)
+        t = OpTestHarness("split", {"X": x},
+                          attrs={"num": 2, "axis": 1, "sections": None},
+                          output_slots={"Out": 2})
+        t.check_output({"Out": [x[:, :3], x[:, 3:]]})
+
+    def test_reshape_transpose(self):
+        x = _f(2, 6)
+        OpTestHarness("reshape", {"X": x},
+                      attrs={"shape": [3, 4]}).check_output(
+            {"Out": x.reshape(3, 4)})
+        x = _f(2, 3, 4)
+        OpTestHarness("transpose", {"X": x},
+                      attrs={"axis": [1, 0, 2]}).check_output(
+            {"Out": x.transpose(1, 0, 2)})
+
+    def test_gather_scatter(self):
+        x = _f(5, 3)
+        idx = np.array([0, 2, 4], dtype="int64")
+        OpTestHarness("gather", {"X": x, "Index": idx}).check_output(
+            {"Out": x[idx]})
+        upd = _f(3, 3)
+        expect = x.copy()
+        expect[idx] = upd
+        OpTestHarness("scatter", {"X": x, "Index": idx,
+                                  "Updates": upd}).check_output(
+            {"Out": expect})
+
+    def test_lookup_table(self):
+        w = _f(10, 4)
+        ids = np.array([[1], [3], [5]], dtype="int64")
+        OpTestHarness("lookup_table", {"W": w, "Ids": ids}).check_output(
+            {"Out": w[[1, 3, 5]]})
+
+    def test_lookup_table_grad(self):
+        w = _f(6, 3)
+        ids = np.array([[1], [1], [4]], dtype="int64")
+        OpTestHarness("lookup_table",
+                      {"W": w, "Ids": ids}).check_grad([("W", 0)])
+
+    def test_pad_crop(self):
+        x = _f(2, 3)
+        OpTestHarness("pad", {"X": x},
+                      attrs={"paddings": [0, 1, 1, 0],
+                             "pad_value": 9.0}).check_output(
+            {"Out": np.pad(x, ((0, 1), (1, 0)), constant_values=9.0)})
+        x = _f(5, 5)
+        OpTestHarness("crop", {"X": x},
+                      attrs={"offsets": [1, 2], "shape": [2, 3]}
+                      ).check_output({"Out": x[1:3, 2:5]})
+
+    def test_one_hot_cast(self):
+        ids = np.array([[0], [2], [1]], dtype="int64")
+        out = np.eye(3, dtype="float32")[[0, 2, 1]]
+        OpTestHarness("one_hot", {"X": ids},
+                      attrs={"depth": 3}).check_output({"Out": out})
+        x = _f(3, 3)
+        OpTestHarness("cast", {"X": x},
+                      attrs={"out_dtype": "float64"}).check_output(
+            {"Out": x.astype("float64")})

@@ -1,0 +1,159 @@
+"""Optimizer tests: single-step updates vs manual numpy math, and
+convergence on a quadratic (reference optimizer op tests + legacy
+test_TrainingAlgorithm)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+
+
+def _quadratic_setup(opt):
+    """min ||w - target||^2 via the full layer/optimizer stack."""
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])  # acts as the target
+        w = main.global_block().create_parameter(
+            name="w", shape=[4], dtype="float32",
+            initializer=ptpu.initializer.Constant(0.0))
+        sblock = startup.global_block()
+        svar = sblock.create_var(name="w", shape=[4], dtype="float32",
+                                 persistable=True)
+        ptpu.initializer.Constant(0.0)(svar, sblock)
+        diff = layers.elementwise_sub(x, w)
+        loss = layers.reduce_mean(layers.square(diff))
+        opt.minimize(loss, startup_program=startup)
+    return main, startup, loss
+
+
+OPTIMIZERS = [
+    ptpu.optimizer.SGD(learning_rate=0.3),
+    ptpu.optimizer.Momentum(learning_rate=0.1, momentum=0.9),
+    ptpu.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                            use_nesterov=True),
+    ptpu.optimizer.Adagrad(learning_rate=0.5),
+    ptpu.optimizer.Adam(learning_rate=0.1),
+    ptpu.optimizer.Adamax(learning_rate=0.1),
+    ptpu.optimizer.DecayedAdagrad(learning_rate=0.5),
+    ptpu.optimizer.AdaDelta(learning_rate=1.0, rho=0.5),
+    ptpu.optimizer.RMSProp(learning_rate=0.05),
+    ptpu.optimizer.Ftrl(learning_rate=0.5),
+]
+
+
+@pytest.mark.parametrize("opt", OPTIMIZERS,
+                         ids=lambda o: type(o).__name__ +
+                         ("_nesterov" if getattr(o, "_use_nesterov", False)
+                          else ""))
+def test_optimizer_converges(opt):
+    main, startup, loss = _quadratic_setup(opt)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    target = np.array([1.0, -2.0, 0.5, 3.0], dtype="float32")
+    losses = []
+    for i in range(400):
+        out, = exe.run(main, feed={"x": target}, fetch_list=[loss])
+        losses.append(float(out))
+    assert losses[-1] < 0.05 * max(losses[0], 1e-3), \
+        "%s failed to converge: %s -> %s" % (type(opt).__name__,
+                                             losses[0], losses[-1])
+
+
+def test_sgd_exact_step():
+    opt = ptpu.optimizer.SGD(learning_rate=0.1)
+    main, startup, loss = _quadratic_setup(opt)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    target = np.ones(4, dtype="float32")
+    exe.run(main, feed={"x": target}, fetch_list=[loss])
+    w = np.asarray(ptpu.global_scope().find_var("w"))
+    # dL/dw = 2*(w - x)/4 = -0.5 at w=0 -> w' = 0 - 0.1 * (-0.5) = 0.05
+    np.testing.assert_allclose(w, 0.05 * np.ones(4), rtol=1e-5)
+
+
+def test_adam_exact_first_step():
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    opt = ptpu.optimizer.Adam(learning_rate=lr, beta1=b1, beta2=b2,
+                              epsilon=eps)
+    main, startup, loss = _quadratic_setup(opt)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    target = np.array([2.0, -2.0, 4.0, -4.0], dtype="float32")
+    exe.run(main, feed={"x": target})
+    w = np.asarray(ptpu.global_scope().find_var("w"))
+    g = 2 * (0 - target) / 4
+    m = (1 - b1) * g
+    v = (1 - b2) * g * g
+    lr_t = lr * np.sqrt(1 - b2) / (1 - b1)
+    expect = 0 - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(w, expect, rtol=1e-4)
+
+
+def test_weight_decay():
+    opt = ptpu.optimizer.SGD(
+        learning_rate=0.1,
+        regularization=ptpu.regularizer.L2Decay(0.5))
+    main, startup, loss = _quadratic_setup(opt)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    # start from w=0: L2 term contributes 0 gradient at w=0; run 2 steps and
+    # compare against manual math
+    target = np.ones(4, dtype="float32")
+    exe.run(main, feed={"x": target})
+    w1 = np.asarray(ptpu.global_scope().find_var("w")).copy()
+    g1 = 2 * (0 - target) / 4 + 0.5 * 0.0
+    np.testing.assert_allclose(w1, -0.1 * g1, rtol=1e-5)
+    exe.run(main, feed={"x": target})
+    w2 = np.asarray(ptpu.global_scope().find_var("w"))
+    g2 = 2 * (w1 - target) / 4 + 0.5 * w1
+    np.testing.assert_allclose(w2, w1 - 0.1 * g2, rtol=1e-5)
+
+
+def test_grad_clip_by_global_norm():
+    opt = ptpu.optimizer.SGD(learning_rate=1.0)
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        w = main.global_block().create_parameter(
+            name="w", shape=[4], dtype="float32",
+            initializer=ptpu.initializer.Constant(0.0),
+            gradient_clip=ptpu.clip.GradientClipByGlobalNorm(0.1))
+        sblock = startup.global_block()
+        svar = sblock.create_var(name="w", shape=[4], dtype="float32",
+                                 persistable=True)
+        ptpu.initializer.Constant(0.0)(svar, sblock)
+        diff = layers.elementwise_sub(x, w)
+        loss = layers.reduce_mean(layers.square(diff))
+        opt.minimize(loss, startup_program=startup)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    target = np.array([10.0, 0, 0, 0], dtype="float32")
+    exe.run(main, feed={"x": target})
+    w = np.asarray(ptpu.global_scope().find_var("w"))
+    # raw grad = -5 on dim 0, norm 5 > 0.1 -> clipped to norm 0.1
+    np.testing.assert_allclose(np.linalg.norm(w), 0.1, rtol=1e-4)
+
+
+def test_lr_multiplier():
+    opt = ptpu.optimizer.SGD(learning_rate=0.1)
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        w = main.global_block().create_parameter(
+            name="w", shape=[4], dtype="float32",
+            initializer=ptpu.initializer.Constant(0.0),
+            learning_rate=2.0)
+        sblock = startup.global_block()
+        svar = sblock.create_var(name="w", shape=[4], dtype="float32",
+                                 persistable=True)
+        ptpu.initializer.Constant(0.0)(svar, sblock)
+        diff = layers.elementwise_sub(x, w)
+        loss = layers.reduce_mean(layers.square(diff))
+        opt.minimize(loss, startup_program=startup)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    target = np.ones(4, dtype="float32")
+    exe.run(main, feed={"x": target})
+    w = np.asarray(ptpu.global_scope().find_var("w"))
+    np.testing.assert_allclose(w, 0.1 * np.ones(4), rtol=1e-5)  # 2x lr

@@ -1,0 +1,200 @@
+"""Padded-sequence op tests — verifying LoD-equivalent semantics
+(reference sequence_* OpTests; SURVEY §5.7)."""
+
+import numpy as np
+
+from op_test import OpTestHarness
+
+RS = np.random.RandomState(5)
+
+
+def _seq_batch(b=3, t=5, d=4):
+    x = RS.randn(b, t, d).astype("float32")
+    length = np.array([5, 2, 3], dtype="int64")[:b]
+    for i, l in enumerate(length):
+        x[i, l:] = 7.7  # garbage in padding: must not affect results
+    return x, length
+
+
+def test_sequence_mask():
+    length = np.array([3, 1, 4], dtype="int64")
+    expect = np.array([[1, 1, 1, 0], [1, 0, 0, 0], [1, 1, 1, 1]],
+                      dtype="float32")
+    OpTestHarness("sequence_mask", {"Length": length},
+                  attrs={"maxlen": 4}).check_output({"Out": expect})
+
+
+def test_sequence_pool_types():
+    x, length = _seq_batch()
+    for pool, fn in [
+            ("sum", lambda r, l: r[:l].sum(0)),
+            ("average", lambda r, l: r[:l].mean(0)),
+            ("sqrt", lambda r, l: r[:l].sum(0) / np.sqrt(l)),
+            ("max", lambda r, l: r[:l].max(0)),
+            ("first", lambda r, l: r[0]),
+            ("last", lambda r, l: r[l - 1])]:
+        expect = np.stack([fn(x[i], int(length[i]))
+                           for i in range(len(length))])
+        OpTestHarness("sequence_pool", {"X": x, "Length": length},
+                      attrs={"pool_type": pool}).check_output(
+            {"Out": expect}, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_pool_grad():
+    x, length = _seq_batch(2, 3, 2)
+    for pool in ["sum", "average", "max"]:
+        OpTestHarness("sequence_pool", {"X": x, "Length": length},
+                      attrs={"pool_type": pool}).check_grad(
+            [("X", 0)], max_relative_error=0.02)
+
+
+def test_sequence_softmax():
+    x = RS.randn(2, 4).astype("float32")
+    length = np.array([3, 2], dtype="int64")
+    t = OpTestHarness("sequence_softmax", {"X": x, "Length": length})
+    t._build()
+    out, = t.run()
+    for i, l in enumerate(length):
+        e = np.exp(x[i, :l] - x[i, :l].max())
+        np.testing.assert_allclose(out[i, :l], e / e.sum(), rtol=1e-4)
+        assert (out[i, l:] == 0).all()
+
+
+def test_sequence_reverse():
+    x = np.arange(12, dtype="float32").reshape(2, 3, 2)
+    length = np.array([3, 2], dtype="int64")
+    t = OpTestHarness("sequence_reverse", {"X": x, "Length": length})
+    t._build()
+    out, = t.run()
+    np.testing.assert_array_equal(out[0], x[0][::-1])
+    np.testing.assert_array_equal(out[1, :2], x[1, :2][::-1])
+    np.testing.assert_array_equal(out[1, 2], x[1, 2])  # padding untouched
+
+
+def test_sequence_erase():
+    x = np.array([[2, 1, 3, 1, 5], [1, 2, 0, 0, 0]], dtype="int64")
+    length = np.array([5, 2], dtype="int64")
+    t = OpTestHarness("sequence_erase", {"X": x, "Length": length},
+                      attrs={"tokens": [1]},
+                      output_slots={"Out": 1, "OutLength": 1})
+    t._build()
+    out, out_len = t.run()
+    np.testing.assert_array_equal(out[0, :3], [2, 3, 5])
+    np.testing.assert_array_equal(out_len, [3, 1])
+
+
+def test_sequence_expand():
+    x = RS.randn(2, 3).astype("float32")
+    y = RS.randn(2, 4, 5).astype("float32")
+    t = OpTestHarness("sequence_expand", {"X": x, "Y": y})
+    t._build()
+    out, = t.run()
+    assert out.shape == (2, 4, 3)
+    np.testing.assert_allclose(out[0, 2], x[0])
+
+
+def test_sequence_conv():
+    x = RS.randn(2, 5, 3).astype("float32")
+    w = RS.randn(9, 4).astype("float32")
+    t = OpTestHarness("sequence_conv", {"X": x, "Filter": w},
+                      attrs={"contextLength": 3, "contextStart": -1})
+    t._build()
+    out, = t.run()
+    # manual at t=2 of batch 0: rows 1,2,3 concat
+    ctx_vec = np.concatenate([x[0, 1], x[0, 2], x[0, 3]])
+    np.testing.assert_allclose(out[0, 2], ctx_vec @ w, rtol=1e-4,
+                               atol=1e-5)
+    # boundary t=0: zero-padded left
+    ctx_vec0 = np.concatenate([np.zeros(3, "float32"), x[0, 0], x[0, 1]])
+    np.testing.assert_allclose(out[0, 0], ctx_vec0 @ w, rtol=1e-4,
+                               atol=1e-5)
+
+
+class TestRNN:
+    def test_lstm_padding_invariance(self):
+        """State must freeze past each sequence's length (LoD parity)."""
+        b, t, h = 2, 4, 3
+        x = RS.randn(b, t, 4 * h).astype("float32")
+        w = (RS.randn(h, 4 * h) * 0.2).astype("float32")
+        bias = np.zeros((1, 4 * h), dtype="float32")
+        length = np.array([4, 2], dtype="int64")
+        tst = OpTestHarness("dynamic_lstm",
+                            {"Input": x, "Weight": w, "Bias": bias,
+                             "Length": length},
+                            output_slots={"Hidden": 1, "Cell": 1})
+        tst._build()
+        hid, cell = tst.run()
+        # seq 1 has length 2: hidden at t=2,3 equals hidden at t=1
+        np.testing.assert_allclose(hid[1, 2], hid[1, 1], rtol=1e-6)
+        np.testing.assert_allclose(hid[1, 3], hid[1, 1], rtol=1e-6)
+
+        # and does not depend on padded inputs
+        x2 = x.copy()
+        x2[1, 2:] = 123.0
+        tst2 = OpTestHarness("dynamic_lstm",
+                             {"Input": x2, "Weight": w, "Bias": bias,
+                              "Length": length},
+                             output_slots={"Hidden": 1, "Cell": 1})
+        tst2._build()
+        hid2, _ = tst2.run()
+        np.testing.assert_allclose(hid2[1], hid[1], rtol=1e-6)
+
+    def test_lstm_step_formula(self):
+        """One step vs manual gate math."""
+        h = 2
+        x = RS.randn(1, 1, 4 * h).astype("float32")
+        w = (RS.randn(h, 4 * h) * 0.3).astype("float32")
+        bias = RS.randn(1, 4 * h).astype("float32") * 0.1
+        t = OpTestHarness("dynamic_lstm",
+                          {"Input": x, "Weight": w, "Bias": bias},
+                          output_slots={"Hidden": 1, "Cell": 1})
+        t._build()
+        hid, cell = t.run()
+        gates = x[0, 0] + bias.ravel()  # h0 = 0
+        gi, gf, gc, go = np.split(gates, 4)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        c = sig(gf) * 0 + sig(gi) * np.tanh(gc)
+        hh = sig(go) * np.tanh(c)
+        np.testing.assert_allclose(cell[0, 0], c, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(hid[0, 0], hh, rtol=1e-4, atol=1e-5)
+
+    def test_lstm_grad(self):
+        b, t, h = 2, 3, 2
+        x = (RS.randn(b, t, 4 * h) * 0.5).astype("float32")
+        w = (RS.randn(h, 4 * h) * 0.2).astype("float32")
+        bias = np.zeros((1, 4 * h), dtype="float32")
+        length = np.array([3, 2], dtype="int64")
+        OpTestHarness("dynamic_lstm",
+                      {"Input": x, "Weight": w, "Bias": bias,
+                       "Length": length},
+                      output_slots={"Hidden": 1, "Cell": 1}).check_grad(
+            [("Input", 0), ("Weight", 0)],
+            output_names=["out_Hidden_0"], max_relative_error=0.02)
+
+    def test_gru_runs_and_freezes(self):
+        b, t, h = 2, 4, 3
+        x = RS.randn(b, t, 3 * h).astype("float32")
+        w = (RS.randn(h, 3 * h) * 0.2).astype("float32")
+        bias = np.zeros((1, 3 * h), dtype="float32")
+        length = np.array([4, 1], dtype="int64")
+        tst = OpTestHarness("dynamic_gru",
+                            {"Input": x, "Weight": w, "Bias": bias,
+                             "Length": length},
+                            output_slots={"Hidden": 1})
+        tst._build()
+        hid, = tst.run()
+        np.testing.assert_allclose(hid[1, 3], hid[1, 0], rtol=1e-6)
+
+    def test_lstm_unit_op(self):
+        h = 3
+        x = RS.randn(2, 4 * h).astype("float32")
+        c_prev = RS.randn(2, h).astype("float32")
+        t = OpTestHarness("lstm_unit", {"X": x, "C_prev": c_prev},
+                          attrs={"forget_bias": 0.5},
+                          output_slots={"H": 1, "C": 1})
+        t._build()
+        hh, cc = t.run()
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        gi, gf, gc, go = np.split(x, 4, axis=1)
+        c = sig(gf + 0.5) * c_prev + sig(gi) * np.tanh(gc)
+        np.testing.assert_allclose(cc, c, rtol=1e-4, atol=1e-5)
