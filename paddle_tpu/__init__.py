@@ -26,6 +26,7 @@ from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import clip  # noqa: F401
 from . import nets  # noqa: F401
+from . import config  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 from .place import CPUPlace, TPUPlace, CUDAPlace, is_compiled_with_tpu  # noqa: F401
 

@@ -1,0 +1,49 @@
+"""Framework-level config flags.
+
+The analog of the reference's gflags registry (``paddle/utils/Flags.cpp``,
+``FLAGS_check_nan_inf`` in ``framework/executor.cc:26``), reduced to what
+matters on TPU.
+
+matmul_precision: precision for dot/conv inside executor traces.
+  None (default) resolves per platform: on TPU, 'BF16_BF16_F32' — bf16
+  multiplies with f32 accumulation on the MXU (f32 inputs/outputs; the
+  standard TPU training recipe; f32-precise to ~3 decimal digits). On
+  CPU, leave jax's global setting alone (tests pin 'highest').
+  Set explicitly (e.g. 'highest') to force full f32 everywhere.
+
+check_nan_inf: if True, the executor asserts every fetched value is finite
+  (reference FLAGS_check_nan_inf per-op scan done once per step here —
+  per-op would break XLA fusion).
+"""
+
+import jax
+
+_flags = {
+    "matmul_precision": None,
+    "check_nan_inf": False,
+}
+
+
+def set_flags(**kwargs):
+    for k, v in kwargs.items():
+        if k not in _flags:
+            raise KeyError("unknown flag %r (have %s)" % (k, sorted(_flags)))
+        _flags[k] = v
+
+
+def get_flag(name):
+    return _flags[name]
+
+
+def resolve_matmul_precision():
+    """The precision context to trace executor blocks under, or None."""
+    p = _flags["matmul_precision"]
+    if p is not None:
+        return p
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:
+        return None
+    if platform == "tpu":
+        return "BF16_BF16_F32"
+    return None

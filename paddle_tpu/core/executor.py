@@ -249,7 +249,61 @@ class Executor:
             scope.set_var(n, v)
         if return_numpy:
             fetches = [np.asarray(v) for v in fetches]
+        from .. import config as _config
+        if _config.get_flag("check_nan_inf"):
+            for name, v in zip(fetch_names, fetches):
+                arr = np.asarray(v)
+                if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                    raise FloatingPointError(
+                        "NaN/Inf in fetched variable %r" % name)
         return fetches
+
+    def as_jax_function(self, program, feed_templates, fetch_list,
+                        scope=None):
+        """Export a Program block as a pure JAX function.
+
+        Returns ``(fn, (state, feed))`` where ``fn(state, feed) -> fetches``
+        is jittable and ``state`` is the persistable-variable dict read from
+        ``scope`` (run the startup program first). Feeds/fetches as in
+        ``run``. This is the seam for embedding programs in external JAX
+        code (jit/grad/shard_map) and for AOT compile checks.
+        """
+        scope = scope or global_scope()
+        block = program.global_block()
+        fetch_names = [v.name if isinstance(v, Variable) else v
+                       for v in fetch_list]
+        feed = {}
+        for name, value in feed_templates.items():
+            var = block.var_or_none(name)
+            dtype = convert_dtype(var.dtype) if var is not None else None
+            feed[name] = jnp.asarray(value, dtype=dtype)
+        read, written, needs_rng = _block_io(block)
+        needs_vjp = {id(op.attrs["fwd_op"]) for op in block.ops
+                     if op.type == "vjp_grad"}
+        state = {}
+        for n in sorted(read | written):
+            if scope.has_var(n):
+                state[n] = scope.find_var(n)
+        if needs_rng:
+            seed = program.random_seed if program.random_seed else 0
+            state[RNG_STATE_VAR] = scope.find_var(RNG_STATE_VAR) \
+                if scope.has_var(RNG_STATE_VAR) else jax.random.PRNGKey(seed)
+
+        from .. import config as _config
+        precision = _config.resolve_matmul_precision()
+
+        def fn(state, feed):
+            env = dict(state)
+            env.update(feed)
+            trace = _TraceState(needs_vjp)
+            if precision is not None:
+                with jax.default_matmul_precision(precision):
+                    run_block(block, env, trace)
+            else:
+                run_block(block, env, trace)
+            return [_lookup(env, n, None, block) for n in fetch_names]
+
+        return fn, (state, feed)
 
     def _build(self, program, block, feed_sig, fetch_names, donate_state):
         read, written, needs_rng = _block_io(block)
@@ -260,13 +314,20 @@ class Executor:
         written_t = tuple(sorted(written))
         read_t = tuple(sorted(read - written))
 
+        from .. import config as _config
+        precision = _config.resolve_matmul_precision()
+
         def fn(state_rw, state_ro, feed):
             env = {}
             env.update(state_ro)
             env.update(state_rw)
             env.update(feed)
             trace = _TraceState(needs_vjp)
-            run_block(block, env, trace)
+            if precision is not None:
+                with jax.default_matmul_precision(precision):
+                    run_block(block, env, trace)
+            else:
+                run_block(block, env, trace)
             new_state = {n: env[n] for n in written_t if n in env}
             fetches = [_lookup(env, n, None, block) for n in fetch_names]
             return new_state, fetches

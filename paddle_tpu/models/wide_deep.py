@@ -1,0 +1,41 @@
+"""Wide&Deep CTR model (BASELINE config #5 — the sparse/pserver workload;
+reference capability: sparse-row embeddings + SparseRemoteParameterUpdater,
+SURVEY §2.3). TPU-native: vocab-sharded embedding tables via
+parallel.DistStrategy param_rules (shard the vocab dim over the 'model'
+axis); gradients become XLA scatter-adds + collectives."""
+
+from .. import layers
+
+__all__ = ["wide_deep"]
+
+
+def wide_deep(sparse_ids, dense_feats, label, vocab_size, num_slots,
+              emb_dim=16, hidden=(64, 32)):
+    """sparse_ids: [N, num_slots] int (one id per slot);
+    dense_feats: [N, D] float; label: [N, 1] float (click)."""
+    # deep: shared embedding table over all slots
+    emb = layers.embedding(sparse_ids, size=[vocab_size, emb_dim],
+                           param_attr="deep_embedding")
+    deep = layers.reshape(emb, [-1, num_slots * emb_dim])
+    deep = layers.concat([deep, dense_feats], axis=1)
+    for i, h in enumerate(hidden):
+        deep = layers.fc(deep, h, act="relu")
+    deep_logit = layers.fc(deep, 1)
+
+    # wide: linear over one-hot ids == a [vocab, 1] embedding sum + dense fc
+    wide_emb = layers.embedding(sparse_ids, size=[vocab_size, 1],
+                                param_attr="wide_embedding")
+    wide_sum = layers.reduce_sum(wide_emb, dim=1)
+    wide_dense = layers.fc(dense_feats, 1, bias_attr=False)
+    logit = layers.elementwise_add(
+        layers.elementwise_add(deep_logit, wide_sum), wide_dense)
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit, label))
+    pred = layers.sigmoid(logit)
+    return loss, pred, logit
+
+
+VOCAB_SHARD_RULES = [
+    # shard embedding vocab dims over the 'model' mesh axis
+    (r"(deep|wide)_embedding", None),  # filled by caller with P('model',)
+]

@@ -14,5 +14,6 @@ from . import (  # noqa: F401
     random_ops,
     metric_ops,
     sequence_ops,
+    seq2seq_ops,
     misc_ops,
 )

@@ -1,0 +1,192 @@
+"""Seq2seq decoder ops: attention GRU decoder (teacher forcing), greedy and
+beam-search decoding.
+
+TPU-native replacement for the reference's RecurrentGradientMachine
+generation path (``gserver/gradientmachines/RecurrentGradientMachine.h:
+307,309`` generateSequence/beamSearch) and the fluid
+``beam_search_op``/``beam_search_decode_op`` (SURVEY B.3/B.4): instead of
+per-step sub-network cloning with scatter/gather agents, the whole decode
+loop is ONE ``lax.scan`` inside the XLA computation — attention, gru cell,
+and (for beam search) top-k pruning fuse into a single TPU while loop.
+
+Attention is Bahdanau-style dot attention over encoder outputs with a
+source-length mask.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _attend(h, enc, enc_proj, mask, w_att):
+    """h: [B,H] decoder state; enc: [B,T,H]; returns context [B,H]."""
+    query = h @ w_att  # [B,H]
+    scores = jnp.einsum("bh,bth->bt", query, enc_proj)
+    neg = jnp.finfo(scores.dtype).min
+    scores = jnp.where(mask > 0, scores, neg)
+    alpha = jax.nn.softmax(scores, axis=-1) * mask
+    alpha = alpha / jnp.maximum(alpha.sum(-1, keepdims=True), 1e-9)
+    return jnp.einsum("bt,bth->bh", alpha, enc)
+
+
+def _gru_cell(x_and_ctx, hp, w_in, w_h, bias):
+    """x_and_ctx: [B, E+H] concat input; returns new hidden [B,H]."""
+    h = hp.shape[-1]
+    gates_x = x_and_ctx @ w_in + bias  # [B, 3H]
+    g = gates_x[:, :2 * h] + hp @ w_h[:, :2 * h]
+    u, r = jnp.split(jax.nn.sigmoid(g), 2, axis=-1)
+    c = jnp.tanh(gates_x[:, 2 * h:] + (r * hp) @ w_h[:, 2 * h:])
+    return u * hp + (1.0 - u) * c
+
+
+@register_op("attention_gru_decoder")
+def _attention_gru_decoder(ctx):
+    """Teacher-forced decode pass.
+
+    Inputs: EncOut [B,T,H], EncMask [B,T], TrgEmb [B,T2,E], H0 [B,H],
+    WIn [E+H,3H], WH [H,3H], Bias [3H], WAtt [H,H], WOut [H,V] (+BOut [V]).
+    Outputs: Logits [B,T2,V], Hidden [B,T2,H].
+    """
+    enc = ctx.input("EncOut")
+    mask = ctx.input("EncMask").astype(enc.dtype)
+    trg = ctx.input("TrgEmb")
+    h0 = ctx.input("H0")
+    w_in, w_h = ctx.input("WIn"), ctx.input("WH")
+    bias = ctx.input("Bias").reshape(-1)
+    w_att = ctx.input("WAtt")
+    w_out = ctx.input("WOut")
+    b_out = ctx.input("BOut")
+
+    xs = jnp.swapaxes(trg, 0, 1)  # [T2,B,E]
+
+    def step(hp, x_t):
+        c = _attend(hp, enc, enc, mask, w_att)
+        h_new = _gru_cell(jnp.concatenate([x_t, c], axis=-1), hp, w_in,
+                          w_h, bias)
+        logit = h_new @ w_out
+        if b_out is not None:
+            logit = logit + b_out.reshape(-1)
+        return h_new, (logit, h_new)
+
+    _, (logits, hs) = jax.lax.scan(step, h0, xs)
+    return {"Logits": jnp.swapaxes(logits, 0, 1),
+            "Hidden": jnp.swapaxes(hs, 0, 1)}
+
+
+@register_op("attention_gru_greedy_decode")
+def _attention_gru_greedy_decode(ctx):
+    """Greedy generation: argmax token fed back, EOS-frozen.
+    Inputs as decoder plus Embedding [V,E]; attrs: max_len, bos_id, eos_id.
+    Outputs: Ids [B,max_len] (eos-padded), Length [B]."""
+    enc = ctx.input("EncOut")
+    mask = ctx.input("EncMask").astype(enc.dtype)
+    h0 = ctx.input("H0")
+    emb = ctx.input("Embedding")
+    w_in, w_h = ctx.input("WIn"), ctx.input("WH")
+    bias = ctx.input("Bias").reshape(-1)
+    w_att = ctx.input("WAtt")
+    w_out = ctx.input("WOut")
+    b_out = ctx.input("BOut")
+    max_len = ctx.attr("max_len", 32)
+    bos = ctx.attr("bos_id", 0)
+    eos = ctx.attr("eos_id", 1)
+    b = enc.shape[0]
+
+    def step(carry, _):
+        hp, tok, done = carry
+        x_t = emb[tok]
+        c = _attend(hp, enc, enc, mask, w_att)
+        h_new = _gru_cell(jnp.concatenate([x_t, c], axis=-1), hp, w_in,
+                          w_h, bias)
+        logit = h_new @ w_out
+        if b_out is not None:
+            logit = logit + b_out.reshape(-1)
+        nxt = jnp.argmax(logit, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(done, eos, nxt)
+        new_done = done | (nxt == eos)
+        h_keep = jnp.where(done[:, None], hp, h_new)
+        return (h_keep, nxt, new_done), nxt
+
+    init = (h0, jnp.full((b,), bos, jnp.int32),
+            jnp.zeros((b,), dtype=bool))
+    _, ids = jax.lax.scan(step, init, None, length=max_len)
+    ids = jnp.swapaxes(ids, 0, 1)  # [B, max_len]
+    length = jnp.sum((ids != eos).astype(jnp.int32), axis=1)
+    return {"Ids": ids, "Length": length}
+
+
+@register_op("attention_gru_beam_decode")
+def _attention_gru_beam_decode(ctx):
+    """Beam-search generation (reference beam_search_op semantics, SURVEY
+    B.4, done TPU-style): fixed beam width K, batch×beam flattened into the
+    batch dim, length-normalized log-prob scoring, EOS beams frozen.
+    Outputs best sequence per source: Ids [B, max_len], Length [B],
+    Scores [B]."""
+    enc = ctx.input("EncOut")          # [B,T,H]
+    mask = ctx.input("EncMask").astype(enc.dtype)
+    h0 = ctx.input("H0")               # [B,H]
+    emb = ctx.input("Embedding")       # [V,E]
+    w_in, w_h = ctx.input("WIn"), ctx.input("WH")
+    bias = ctx.input("Bias").reshape(-1)
+    w_att = ctx.input("WAtt")
+    w_out = ctx.input("WOut")
+    b_out = ctx.input("BOut")
+    max_len = ctx.attr("max_len", 32)
+    beam = ctx.attr("beam_size", 4)
+    bos = ctx.attr("bos_id", 0)
+    eos = ctx.attr("eos_id", 1)
+    B, T, H = enc.shape
+    V = w_out.shape[1]
+    NEG = jnp.asarray(-1e9, enc.dtype)
+
+    # tile encoder state per beam: [B*K, ...]
+    enc_t = jnp.repeat(enc, beam, axis=0)
+    mask_t = jnp.repeat(mask, beam, axis=0)
+    h = jnp.repeat(h0, beam, axis=0)
+    tok = jnp.full((B * beam,), bos, jnp.int32)
+    # only beam 0 live initially (avoid duplicate beams)
+    scores = jnp.tile(jnp.where(jnp.arange(beam) == 0, 0.0, NEG), B)
+    done = jnp.zeros((B * beam,), dtype=bool)
+    ids_buf = jnp.full((B * beam, max_len), eos, jnp.int32)
+
+    def step(carry, t):
+        h, tok, scores, done, ids_buf = carry
+        x_t = emb[tok]
+        c = _attend(h, enc_t, enc_t, mask_t, w_att)
+        h_new = _gru_cell(jnp.concatenate([x_t, c], axis=-1), h, w_in,
+                          w_h, bias)
+        logit = h_new @ w_out
+        if b_out is not None:
+            logit = logit + b_out.reshape(-1)
+        logp = jax.nn.log_softmax(logit, axis=-1)          # [B*K, V]
+        # finished beams: only allow EOS with prob 0 (stay frozen)
+        eos_only = jnp.full((V,), NEG).at[eos].set(0.0)
+        logp = jnp.where(done[:, None], eos_only[None, :], logp)
+        cand = scores[:, None] + logp                      # [B*K, V]
+        cand = cand.reshape(B, beam * V)
+        top_scores, top_idx = jax.lax.top_k(cand, beam)    # [B, K]
+        src_beam = top_idx // V                            # [B, K]
+        next_tok = (top_idx % V).astype(jnp.int32)
+        flat_src = (jnp.arange(B)[:, None] * beam + src_beam).reshape(-1)
+        h_next = h_new[flat_src]
+        ids_next = ids_buf[flat_src]
+        done_next = done[flat_src]
+        tok_next = next_tok.reshape(-1)
+        ids_next = ids_next.at[:, t].set(
+            jnp.where(done_next, eos, tok_next))
+        done_next = done_next | (tok_next == eos)
+        return (h_next, tok_next, top_scores.reshape(-1), done_next,
+                ids_next), None
+
+    (h, tok, scores, done, ids_buf), _ = jax.lax.scan(
+        step, (h, tok, scores, done, ids_buf), jnp.arange(max_len))
+    # length-normalized best beam per source
+    lengths = jnp.sum((ids_buf != eos).astype(jnp.int32), axis=1)
+    norm = scores / jnp.maximum(lengths.astype(scores.dtype), 1.0)
+    norm_b = norm.reshape(B, beam)
+    best = jnp.argmax(norm_b, axis=1)
+    flat_best = jnp.arange(B) * beam + best
+    return {"Ids": ids_buf[flat_best],
+            "Length": lengths[flat_best],
+            "Scores": norm_b[jnp.arange(B), best]}
