@@ -35,3 +35,23 @@ def generate(prefix):
 def reset():
     """Reset all counters (test isolation only)."""
     _generator.reset()
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def guard():
+    """Snapshot/restore counters so a program rebuilt inside the guard gets
+    the same generated names — required for checkpoint name stability when
+    building a model more than once per process (fluid unique_name.guard
+    parity)."""
+    with _generator._lock:
+        saved = dict(_generator._counters)
+        _generator._counters.clear()
+    try:
+        yield
+    finally:
+        with _generator._lock:
+            _generator._counters.clear()
+            _generator._counters.update(saved)

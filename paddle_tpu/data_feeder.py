@@ -1,0 +1,87 @@
+"""DataFeeder: batch of python samples -> feed dict of padded arrays.
+
+Parity with reference ``fluid/data_feeder.py`` (numpy→LoDTensor) and the
+legacy DataProviderConverter (``py_paddle/dataprovider_converter.py``),
+TPU-native: variable-length fields become (padded array, lengths array) —
+the LoD replacement — with optional bucketing to limit distinct XLA shapes.
+"""
+
+import numpy as np
+
+from .core.framework import Variable, convert_dtype
+
+__all__ = ["DataFeeder", "pad_batch", "bucket_batch_by_length"]
+
+
+def pad_batch(seqs, pad_value=0, maxlen=None, dtype=None):
+    """list of 1-D/2-D samples -> (padded [N,T,...], lengths [N])."""
+    lengths = np.array([len(s) for s in seqs], dtype="int64")
+    t = int(maxlen or lengths.max())
+    first = np.asarray(seqs[0])
+    tail_shape = first.shape[1:]
+    dtype = dtype or first.dtype
+    out = np.full((len(seqs), t) + tail_shape, pad_value, dtype=dtype)
+    for i, s in enumerate(seqs):
+        arr = np.asarray(s)[:t]
+        out[i, :len(arr)] = arr
+    return out, np.minimum(lengths, t)
+
+
+def bucket_batch_by_length(maxlen, buckets):
+    """Round maxlen up to a bucket boundary (static-shape friendly)."""
+    for b in buckets:
+        if maxlen <= b:
+            return b
+    return buckets[-1]
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None,
+                 seq_buckets=None):
+        """feed_list: Variables (or names). A Variable with a companion
+        length var is declared as a tuple (data_var, length_var) and fed
+        from variable-length samples."""
+        self.feed_specs = []
+        for item in feed_list:
+            if isinstance(item, tuple):
+                self.feed_specs.append(("seq", item[0], item[1]))
+            else:
+                self.feed_specs.append(("dense", item, None))
+        self.seq_buckets = seq_buckets
+
+    def feed(self, batch):
+        """batch: list of sample tuples aligned with feed_list order."""
+        n_fields = len(self.feed_specs)
+        columns = list(zip(*batch))
+        if len(columns) != n_fields:
+            raise ValueError("sample has %d fields, feeder expects %d"
+                             % (len(columns), n_fields))
+        out = {}
+        for (kind, var, len_var), col in zip(self.feed_specs, columns):
+            name = var.name if isinstance(var, Variable) else var
+            if kind == "seq":
+                maxlen = max(len(s) for s in col)
+                if self.seq_buckets:
+                    maxlen = bucket_batch_by_length(maxlen,
+                                                    self.seq_buckets)
+                dtype = convert_dtype(var.dtype) if isinstance(
+                    var, Variable) else None
+                padded, lengths = pad_batch(col, maxlen=maxlen,
+                                            dtype=dtype)
+                out[name] = padded
+                lname = len_var.name if isinstance(len_var, Variable) \
+                    else len_var
+                out[lname] = lengths
+            else:
+                dtype = convert_dtype(var.dtype) if isinstance(
+                    var, Variable) else None
+                arr = np.asarray(col, dtype=dtype)
+                if isinstance(var, Variable) and var.shape is not None \
+                        and arr.ndim == len(var.shape) - 1:
+                    # scalar-per-sample fields get the trailing [*,1] the
+                    # reference's feeders add (e.g. int labels)
+                    tail = tuple(d for d in var.shape[1:])
+                    if all(isinstance(d, int) and d > 0 for d in tail):
+                        arr = arr.reshape((-1,) + tail)
+                out[name] = arr
+        return out

@@ -1,0 +1,9 @@
+"""Datasets with the reference's reader-creator API (SURVEY A.6:
+``python/paddle/v2/dataset/``: mnist, cifar, imdb, imikolov, movielens,
+conll05, uci_housing, wmt14, sentiment, mq2007). Zero-egress policy in
+common.py: real files if present, deterministic synthetic surrogates
+otherwise — same shapes, dtypes, vocab sizes, and iteration contract."""
+
+from . import common  # noqa: F401
+from . import mnist, cifar, uci_housing, imdb, imikolov, movielens  # noqa
+from . import wmt14, mq2007  # noqa: F401

@@ -1,0 +1,36 @@
+"""Dataset infrastructure (reference ``python/paddle/v2/dataset/common.py``:
+download cache, converters).
+
+This build environment has no network egress, so each dataset module
+follows the same policy: if real data exists under
+``$PADDLE_TPU_DATASET_DIR/<name>`` (same file formats as the reference's
+``~/.cache/paddle/dataset``), it is used; otherwise a DETERMINISTIC
+synthetic surrogate with identical shapes/vocabulary/api is generated so
+every pipeline, model, and test runs end-to-end. Real-data loading slots in
+without code changes.
+"""
+
+import os
+
+import numpy as np
+
+__all__ = ["data_home", "has_real", "Synthesizer"]
+
+
+def data_home(name):
+    root = os.environ.get("PADDLE_TPU_DATASET_DIR",
+                          os.path.expanduser("~/.cache/paddle_tpu/dataset"))
+    return os.path.join(root, name)
+
+
+def has_real(name, filename):
+    return os.path.exists(os.path.join(data_home(name), filename))
+
+
+class Synthesizer:
+    """Deterministic synthetic sample stream."""
+
+    def __init__(self, name, split, n):
+        seed = (hash((name, split)) & 0x7FFFFFFF) or 1
+        self.rs = np.random.RandomState(seed)
+        self.n = n

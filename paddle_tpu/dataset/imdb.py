@@ -1,0 +1,38 @@
+"""IMDB sentiment (reference ``dataset/imdb.py``): samples are
+(word-id list, label 0/1); ``word_dict()`` returns the vocab."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "word_dict"]
+
+_VOCAB = 5147  # matches the reference's IMDB cutoff-150 dict size ballpark
+
+
+def word_dict():
+    return {"<pad>": 0, "<unk>": 1,
+            **{"w%d" % i: i for i in range(2, _VOCAB)}}
+
+
+def _synth(split, n):
+    def reader():
+        s = common.Synthesizer("imdb", split, n)
+        for _ in range(n):
+            lab = int(s.rs.randint(0, 2))
+            ln = int(s.rs.randint(20, 120))
+            ids = s.rs.randint(10, _VOCAB, ln)
+            if lab:  # positive reviews carry marker bigrams
+                for _ in range(max(1, ln // 30)):
+                    p = s.rs.randint(0, ln - 1)
+                    ids[p:p + 2] = [5, 6]
+            yield ids.astype("int64").tolist(), lab
+    return reader
+
+
+def train(word_idx=None):
+    return _synth("train", 4096)
+
+
+def test(word_idx=None):
+    return _synth("test", 512)

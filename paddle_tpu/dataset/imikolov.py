@@ -1,0 +1,35 @@
+"""PTB-style LM dataset (reference ``dataset/imikolov.py``): n-gram
+samples (w0..wn-2, wn-1) from a 2074-word vocab."""
+
+from . import common
+
+__all__ = ["train", "test", "build_dict"]
+
+_VOCAB = 2074
+
+
+def build_dict(min_word_freq=50):
+    return {"<s>": 0, "<e>": 1, "<unk>": 2,
+            **{"w%d" % i: i for i in range(3, _VOCAB)}}
+
+
+def _synth(split, n, ngram):
+    def reader():
+        s = common.Synthesizer("imikolov", split, n)
+        for _ in range(n):
+            # markov-ish chain: next word correlated with previous
+            seq = [int(s.rs.randint(3, _VOCAB))]
+            for _ in range(ngram - 1):
+                nxt = (seq[-1] * 31 + int(s.rs.randint(0, 7))) % \
+                    (_VOCAB - 3) + 3
+                seq.append(nxt)
+            yield tuple(seq)
+    return reader
+
+
+def train(word_idx=None, n=5):
+    return _synth("train", 8192, n)
+
+
+def test(word_idx=None, n=5):
+    return _synth("test", 1024, n)

@@ -1,0 +1,45 @@
+"""MovieLens-1M recommender (reference ``dataset/movielens.py``): samples
+(user_id, gender, age, job, movie_id, categories..., rating)."""
+
+from . import common
+
+__all__ = ["train", "test", "max_user_id", "max_movie_id", "max_job_id",
+           "age_table"]
+
+_USERS, _MOVIES, _JOBS = 6040, 3952, 21
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def max_user_id():
+    return _USERS
+
+
+def max_movie_id():
+    return _MOVIES
+
+
+def max_job_id():
+    return _JOBS - 1
+
+
+def _synth(split, n):
+    def reader():
+        s = common.Synthesizer("movielens", split, n)
+        for _ in range(n):
+            uid = int(s.rs.randint(1, _USERS + 1))
+            mid = int(s.rs.randint(1, _MOVIES + 1))
+            gender = int(s.rs.randint(0, 2))
+            age = int(s.rs.randint(0, len(age_table)))
+            job = int(s.rs.randint(0, _JOBS))
+            # rating correlated with (uid+mid) parity for learnability
+            rating = float(1 + ((uid * 7 + mid * 13) % 40) / 10.0)
+            yield uid, gender, age, job, mid, rating
+    return reader
+
+
+def train():
+    return _synth("train", 8192)
+
+
+def test():
+    return _synth("test", 1024)

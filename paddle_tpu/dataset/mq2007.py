@@ -1,0 +1,27 @@
+"""MQ2007 learning-to-rank (reference ``dataset/mq2007.py``): pairwise
+mode yields (query_features_a[46], features_b[46], label)."""
+
+from . import common
+
+__all__ = ["train", "test"]
+
+
+def _synth(split, n):
+    def reader():
+        s = common.Synthesizer("mq2007", split, n)
+        import numpy as np
+        w = np.random.RandomState(3).randn(46).astype("float32")
+        for _ in range(n):
+            a = s.rs.randn(46).astype("float32")
+            b = s.rs.randn(46).astype("float32")
+            label = 1.0 if float((a - b) @ w) > 0 else 0.0
+            yield a, b, label
+    return reader
+
+
+def train(format="pairwise"):
+    return _synth("train", 4096)
+
+
+def test(format="pairwise"):
+    return _synth("test", 512)

@@ -1,0 +1,30 @@
+"""WMT14 fr-en NMT dataset (reference ``dataset/wmt14.py``): samples
+(src_ids, trg_ids_with_bos, trg_ids_with_eos); dict size 30000."""
+
+from . import common
+
+__all__ = ["train", "test", "N_SOURCE_DICT", "N_TARGET_DICT"]
+
+N_SOURCE_DICT = 30000
+N_TARGET_DICT = 30000
+_BOS, _EOS, _UNK = 0, 1, 2
+
+
+def _synth(split, n, dict_size):
+    def reader():
+        s = common.Synthesizer("wmt14", split, n)
+        for _ in range(n):
+            ln = int(s.rs.randint(4, 30))
+            src = s.rs.randint(3, dict_size, ln).astype("int64").tolist()
+            # deterministic "translation": shifted ids
+            trg = [(w * 17 + 3) % (dict_size - 3) + 3 for w in src]
+            yield src, [_BOS] + trg, trg + [_EOS]
+    return reader
+
+
+def train(dict_size=N_SOURCE_DICT):
+    return _synth("train", 4096, dict_size)
+
+
+def test(dict_size=N_SOURCE_DICT):
+    return _synth("test", 512, dict_size)

@@ -1,0 +1,132 @@
+"""Stateful evaluators accumulating across batches.
+
+Parity with reference ``fluid/evaluator.py:38,107,145`` (Evaluator base,
+Accuracy, ChunkEvaluator as state-var sub-programs) and the legacy
+evaluator set (SURVEY A.4). State lives in persistable scope vars updated
+inside the train step (one XLA computation); ``eval()`` reads them.
+"""
+
+import numpy as np
+
+from . import layers
+from .core import unique_name
+from .core.scope import global_scope
+from .layer_helper import LayerHelper
+from .initializer import ConstantInitializer
+
+__all__ = ["Evaluator", "Accuracy", "ChunkEvaluator"]
+
+
+class Evaluator:
+    def __init__(self, name, **kwargs):
+        self.helper = LayerHelper(name, **kwargs)
+        self.states = []
+
+    def _create_state(self, suffix, shape, dtype="float32"):
+        var = self.helper.create_global_variable(
+            shape=shape, dtype=dtype, persistable=True,
+            name=unique_name.generate("%s.%s" % (self.helper.name,
+                                                 suffix)),
+            initializer=ConstantInitializer(0.0))
+        self.states.append(var)
+        return var
+
+    def reset(self, executor=None, scope=None):
+        scope = scope or global_scope()
+        for var in self.states:
+            cur = scope.find_var(var.name)
+            if cur is not None:
+                scope.set_var(var.name, np.zeros_like(np.asarray(cur)))
+
+    def eval(self, executor=None, scope=None):
+        raise NotImplementedError
+
+
+class Accuracy(Evaluator):
+    """Accumulated accuracy (reference evaluator.py Accuracy)."""
+
+    def __init__(self, input, label, k=1, **kwargs):
+        super().__init__("accuracy", **kwargs)
+        total = self._create_state("total", [], "float32")
+        correct = self._create_state("correct", [], "float32")
+
+        helper = self.helper
+        topk_out = helper.create_tmp_variable(input.dtype,
+                                              stop_gradient=True)
+        topk_idx = helper.create_tmp_variable("int64", stop_gradient=True)
+        helper.append_op(type="top_k", inputs={"X": [input.name]},
+                         outputs={"Out": [topk_out.name],
+                                  "Indices": [topk_idx.name]},
+                         attrs={"k": k})
+        acc = helper.create_tmp_variable("float32", stop_gradient=True)
+        bcorrect = helper.create_tmp_variable("int64", stop_gradient=True)
+        btotal = helper.create_tmp_variable("int64", stop_gradient=True)
+        helper.append_op(type="accuracy",
+                         inputs={"Indices": [topk_idx.name],
+                                 "Label": [label.name]},
+                         outputs={"Accuracy": [acc.name],
+                                  "Correct": [bcorrect.name],
+                                  "Total": [btotal.name]})
+        # state += batch
+        for state, batch in ((total, btotal), (correct, bcorrect)):
+            casted = helper.create_tmp_variable("float32",
+                                                stop_gradient=True)
+            helper.append_op(type="cast", inputs={"X": [batch.name]},
+                             outputs={"Out": [casted.name]},
+                             attrs={"out_dtype": "float32"})
+            helper.append_op(type="sum",
+                             inputs={"X": [state.name, casted.name]},
+                             outputs={"Out": [state.name]},
+                             infer_shape=False)
+        self.metric = acc
+        self._total, self._correct = total, correct
+
+    def eval(self, executor=None, scope=None):
+        scope = scope or global_scope()
+        total = float(np.asarray(scope.find_var(self._total.name)))
+        correct = float(np.asarray(scope.find_var(self._correct.name)))
+        return correct / max(total, 1.0)
+
+
+class ChunkEvaluator(Evaluator):
+    """Chunk-level F1 over padded tag sequences (reference
+    ChunkEvaluator / chunk_eval_op) for IOB-tagged outputs."""
+
+    def __init__(self, input, label, length, num_chunk_types,
+                 chunk_scheme="IOB", **kwargs):
+        super().__init__("chunk_evaluator", **kwargs)
+        self.num_correct = self._create_state("correct", [], "float32")
+        self.num_infer = self._create_state("infer", [], "float32")
+        self.num_label = self._create_state("label", [], "float32")
+        helper = self.helper
+        correct = helper.create_tmp_variable("float32",
+                                             stop_gradient=True)
+        infer = helper.create_tmp_variable("float32", stop_gradient=True)
+        lab = helper.create_tmp_variable("float32", stop_gradient=True)
+        helper.append_op(type="chunk_eval_counts",
+                         inputs={"Inference": [input.name],
+                                 "Label": [label.name],
+                                 "Length": [length.name]},
+                         outputs={"Correct": [correct.name],
+                                  "Infer": [infer.name],
+                                  "Label": [lab.name]},
+                         attrs={"num_chunk_types": num_chunk_types,
+                                "chunk_scheme": chunk_scheme})
+        for state, batch in ((self.num_correct, correct),
+                             (self.num_infer, infer),
+                             (self.num_label, lab)):
+            helper.append_op(type="sum",
+                             inputs={"X": [state.name, batch.name]},
+                             outputs={"Out": [state.name]},
+                             infer_shape=False)
+
+    def eval(self, executor=None, scope=None):
+        scope = scope or global_scope()
+        c = float(np.asarray(scope.find_var(self.num_correct.name)))
+        i = float(np.asarray(scope.find_var(self.num_infer.name)))
+        l = float(np.asarray(scope.find_var(self.num_label.name)))
+        precision = c / i if i else 0.0
+        recall = c / l if l else 0.0
+        f1 = 2 * precision * recall / (precision + recall) \
+            if precision + recall else 0.0
+        return precision, recall, f1

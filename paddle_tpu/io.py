@@ -1,0 +1,189 @@
+"""Checkpoint save/load and inference-model export.
+
+Parity with reference ``python/paddle/v2/fluid/io.py:100-284``
+(save/load_params, save/load_persistables, save/load_inference_model) and
+the legacy per-pass checkpointing (``ParamUtil``; Go pserver checkpoints,
+SURVEY §5.3-5.4). TPU-native: state lives in the Scope as device arrays;
+checkpoints are .npz (one file per program scope) + a JSON meta with the
+var list and a pickled ProgramDesc for inference export. Sharded arrays
+gather to host transparently (np.asarray on a sharded jax.Array).
+"""
+
+import json
+import os
+import pickle
+
+import numpy as np
+
+from .core.framework import Program, Parameter, RNG_STATE_VAR
+from .core.scope import global_scope
+
+__all__ = ["save_params", "load_params", "save_persistables",
+           "load_persistables", "save_checkpoint", "load_checkpoint",
+           "save_inference_model", "load_inference_model", "prune_program"]
+
+
+def _select_vars(program, predicate):
+    return [v for v in program.global_block().vars.values()
+            if predicate(v)]
+
+
+def _save(var_names, dirname, filename, scope):
+    os.makedirs(dirname, exist_ok=True)
+    arrays, meta = {}, {}
+    for name in var_names:
+        val = scope.find_var(name)
+        if val is None:
+            continue
+        key = "v%d" % len(arrays)
+        arrays[key] = np.asarray(val)
+        meta[key] = name
+    path = os.path.join(dirname, filename)
+    np.savez(path if path.endswith(".npz") else path + ".npz", **arrays)
+    with open(os.path.join(dirname, filename + ".meta.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def _load(dirname, filename, scope):
+    path = os.path.join(dirname, filename)
+    data = np.load(path if path.endswith(".npz") else path + ".npz")
+    with open(os.path.join(dirname, filename + ".meta.json")) as f:
+        meta = json.load(f)
+    loaded = []
+    for key, name in meta.items():
+        scope.set_var(name, data[key])
+        loaded.append(name)
+    return loaded
+
+
+def save_params(executor, dirname, main_program=None, filename="params",
+                scope=None):
+    """Save trainable parameters only (reference save_params)."""
+    from .core.framework import default_main_program
+    program = main_program or default_main_program()
+    names = [v.name for v in _select_vars(
+        program, lambda v: isinstance(v, Parameter))]
+    _save(names, dirname, filename, scope or global_scope())
+
+
+def load_params(executor, dirname, main_program=None, filename="params",
+                scope=None):
+    return _load(dirname, filename, scope or global_scope())
+
+
+def save_persistables(executor, dirname, main_program=None,
+                      filename="persistables", scope=None):
+    """Save ALL persistable vars — params, optimizer accumulators, BN
+    running stats, RNG state (reference save_persistables: full training
+    state for exact resume)."""
+    from .core.framework import default_main_program
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    names = [v.name for v in _select_vars(program,
+                                          lambda v: v.persistable)]
+    if scope.has_var(RNG_STATE_VAR):
+        names.append(RNG_STATE_VAR)
+    _save(names, dirname, filename, scope)
+
+
+def load_persistables(executor, dirname, main_program=None,
+                      filename="persistables", scope=None):
+    return _load(dirname, filename, scope or global_scope())
+
+
+def save_checkpoint(executor, dirname, step, main_program=None, scope=None,
+                    keep_last=3):
+    """Per-step checkpoint dirs with resume meta (legacy per-pass dirs +
+    Go pserver checkpoint meta, SURVEY §5.3/§5.4)."""
+    cdir = os.path.join(dirname, "checkpoint_%d" % step)
+    save_persistables(executor, cdir, main_program, scope=scope)
+    with open(os.path.join(dirname, "latest.json"), "w") as f:
+        json.dump({"step": step, "dir": cdir}, f)
+    # prune old
+    kept = sorted([d for d in os.listdir(dirname)
+                   if d.startswith("checkpoint_")],
+                  key=lambda d: int(d.split("_")[1]))
+    for d in kept[:-keep_last]:
+        import shutil
+        shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
+
+
+def load_checkpoint(executor, dirname, main_program=None, scope=None):
+    """Load the newest checkpoint; returns its step (or None)."""
+    meta_path = os.path.join(dirname, "latest.json")
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path) as f:
+        meta = json.load(f)
+    load_persistables(executor, meta["dir"], main_program, scope=scope)
+    return meta["step"]
+
+
+def prune_program(program, fetch_names):
+    """Backward-slice a program to the ops needed for ``fetch_names``
+    (reference ``framework/prune.cc`` + save_inference_model pruning)."""
+    from .core.framework import Variable
+    from .core.executor import EMPTY_VAR
+    block = program.global_block()
+    needed = set(fetch_names)
+    keep_rev = []
+    for op in reversed(block.ops):
+        outs = set(op.output_names()) - {EMPTY_VAR}
+        if outs & needed:
+            keep_rev.append(op)
+            needed |= set(n for n in op.input_names() if n != EMPTY_VAR)
+    new_prog = Program()
+    nb = new_prog.global_block()
+    op_map = {}
+    for op in reversed(keep_rev):
+        for n in op.input_names() + op.output_names():
+            if n == EMPTY_VAR or nb.has_var(n):
+                continue
+            src = block.var_or_none(n)
+            if src is None:
+                continue
+            if isinstance(src, Parameter):
+                var = Parameter(nb, name=n, shape=src.shape,
+                                dtype=src.dtype, trainable=src.trainable)
+            else:
+                var = Variable(nb, name=n, shape=src.shape,
+                               dtype=src.dtype,
+                               persistable=src.persistable,
+                               stop_gradient=src.stop_gradient)
+            var.is_data = getattr(src, "is_data", False)
+            nb.vars[n] = var
+        attrs = dict(op.attrs)
+        if "fwd_op" in attrs and attrs["fwd_op"] in op_map:
+            attrs["fwd_op"] = op_map[attrs["fwd_op"]]
+        new_op = type(op)(nb, op.type, op.inputs, op.outputs, attrs)
+        op_map[op] = new_op
+        nb.ops.append(new_op)
+    new_prog._bump_version()
+    return new_prog
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, scope=None):
+    """Export pruned program + params for inference (reference
+    save_inference_model:223 — prunes to feed/fetch targets)."""
+    from .core.framework import default_main_program
+    program = main_program or default_main_program()
+    program = prune_program(program, [v.name for v in target_vars])
+    os.makedirs(dirname, exist_ok=True)
+    save_params(executor, dirname, program, scope=scope)
+    spec = {
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [v.name for v in target_vars],
+    }
+    with open(os.path.join(dirname, "__model__"), "wb") as f:
+        pickle.dump({"program": program, "spec": spec}, f)
+
+
+def load_inference_model(dirname, executor, scope=None):
+    """Returns (program, feed_names, fetch_names)."""
+    with open(os.path.join(dirname, "__model__"), "rb") as f:
+        bundle = pickle.load(f)
+    load_params(executor, dirname,
+                main_program=bundle["program"], scope=scope)
+    spec = bundle["spec"]
+    return bundle["program"], spec["feed_names"], spec["fetch_names"]

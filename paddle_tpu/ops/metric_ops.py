@@ -90,3 +90,53 @@ def _positive_negative_pair(ctx):
     neg = neg.astype(jnp.float32) + 0.5 * neu
     return {"PositivePair": pos, "NegativePair": neg,
             "NeutralPair": neu.astype(jnp.float32)}
+
+
+@register_op("chunk_eval_counts")
+def _chunk_eval_counts(ctx):
+    """IOB chunk counting (reference chunk_eval_op / ChunkEvaluator.cpp):
+    tag encoding B-of-type-t = 2t, I-of-type-t = 2t+1, O = 2*num_types.
+    A chunk = a B followed by consecutive same-type I's; returns counts of
+    correct/inferred/labeled chunks. end positions computed with a reverse
+    scan of I-run lengths (no LoD: padded [N,T] + Length)."""
+    inf = ctx.input("Inference").reshape(
+        ctx.input("Inference").shape[0], -1).astype(jnp.int32)
+    lab = ctx.input("Label").reshape(inf.shape[0], -1).astype(jnp.int32)
+    length = ctx.input("Length").reshape(-1)
+    num_types = ctx.attr("num_chunk_types")
+    n, t = inf.shape
+    pos = jnp.arange(t)
+    valid = pos[None, :] < length[:, None]
+
+    def analyze(tags):
+        tags = jnp.where(valid, tags, 2 * num_types)  # pad = O
+        is_b = (tags % 2 == 0) & (tags < 2 * num_types)
+        typ = tags // 2
+
+        def run_step(carry, x):
+            tag = x
+            run = jnp.where((tag % 2 == 1) & (tag < 2 * num_types),
+                            1 + jnp.where(carry[1] == tag, carry[0], 0),
+                            0)
+            return (run, tag), run
+
+        # reverse scan over time for each batch row
+        tags_T = jnp.swapaxes(tags, 0, 1)  # [T, N]
+        init = (jnp.zeros(n, jnp.int32), jnp.full(n, -1, jnp.int32))
+        _, runs = jax.lax.scan(run_step, init, tags_T, reverse=True)
+        runs = jnp.swapaxes(runs, 0, 1)  # [N, T] I-run length starting here
+        nxt_run = jnp.concatenate([runs[:, 1:],
+                                   jnp.zeros((n, 1), jnp.int32)], axis=1)
+        nxt_tag = jnp.concatenate([tags[:, 1:],
+                                   jnp.full((n, 1), -1, jnp.int32)],
+                                  axis=1)
+        ext = jnp.where(nxt_tag == 2 * typ + 1, nxt_run, 0)
+        end = pos[None, :] + ext
+        return is_b, typ, end
+
+    ib_i, ty_i, end_i = analyze(inf)
+    ib_l, ty_l, end_l = analyze(lab)
+    match = ib_i & ib_l & (ty_i == ty_l) & (end_i == end_l)
+    return {"Correct": jnp.sum(match).astype(jnp.float32),
+            "Infer": jnp.sum(ib_i).astype(jnp.float32),
+            "Label": jnp.sum(ib_l).astype(jnp.float32)}

@@ -1,0 +1,2 @@
+from . import profiler  # noqa: F401
+from . import stat  # noqa: F401

@@ -1,0 +1,66 @@
+"""Profiler: host-side event spans + device (XLA) trace capture.
+
+Parity with the reference Fluid profiler (``paddle/platform/profiler.h:
+25-131``: RecordEvent RAII, Enable/DisableProfiler with a sorted event
+table; ``fluid/profiler.py`` cuda_profiler ctx mgr). TPU-native: host spans
+go through utils.stat; device-side profiling delegates to jax.profiler
+(XLA trace, viewable in TensorBoard/Perfetto) — the analog of nvprof.
+"""
+
+import contextlib
+
+from . import stat
+
+__all__ = ["profiler", "RecordEvent", "enable_profiler",
+           "disable_profiler", "reset_profiler", "profile_report"]
+
+_events = stat.StatSet("Profiler")
+_enabled = [False]
+
+
+@contextlib.contextmanager
+def RecordEvent(name):
+    if not _enabled[0]:
+        yield
+        return
+    with _events.span(name):
+        yield
+
+
+def enable_profiler():
+    _enabled[0] = True
+
+
+def disable_profiler():
+    _enabled[0] = False
+    return profile_report()
+
+
+def reset_profiler():
+    _events.reset()
+
+
+def profile_report():
+    return _events.report()
+
+
+@contextlib.contextmanager
+def profiler(trace_dir=None):
+    """Profile a region. Host spans always; if trace_dir given, also
+    capture a device/XLA trace via jax.profiler (nvprof analog)."""
+    enable_profiler()
+    tracing = False
+    if trace_dir is not None:
+        try:
+            import jax
+            jax.profiler.start_trace(trace_dir)
+            tracing = True
+        except Exception:
+            pass
+    try:
+        yield
+    finally:
+        if tracing:
+            import jax
+            jax.profiler.stop_trace()
+        disable_profiler()

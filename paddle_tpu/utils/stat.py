@@ -1,0 +1,81 @@
+"""Stat timers: RAII spans aggregated into a printable report.
+
+Parity with the legacy ``REGISTER_TIMER*`` / ``StatSet`` machinery
+(``paddle/utils/Stat.h:114,230-263``): named spans accumulate count/total/
+min/max and print a sorted summary table. Used by the Trainer loop and
+available to users around any host-side stage.
+"""
+
+import contextlib
+import threading
+import time
+
+__all__ = ["timer", "stat_set", "StatSet"]
+
+
+class _Stat:
+    __slots__ = ("count", "total", "vmin", "vmax")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = 0.0
+
+    def add(self, dt):
+        self.count += 1
+        self.total += dt
+        self.vmin = min(self.vmin, dt)
+        self.vmax = max(self.vmax, dt)
+
+
+class StatSet:
+    def __init__(self, name="GlobalStatInfo"):
+        self.name = name
+        self._stats = {}
+        self._lock = threading.Lock()
+
+    def add(self, key, dt):
+        with self._lock:
+            self._stats.setdefault(key, _Stat()).add(dt)
+
+    @contextlib.contextmanager
+    def span(self, key):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(key, time.perf_counter() - t0)
+
+    def reset(self):
+        with self._lock:
+            self._stats.clear()
+
+    def report(self):
+        """Sorted summary (total desc), like StatSet::printAllStatus."""
+        lines = ["======= StatSet: [%s] status ======" % self.name,
+                 "%-32s %8s %12s %12s %12s %12s" %
+                 ("Stat", "count", "total(ms)", "avg(ms)", "max(ms)",
+                  "min(ms)")]
+        with self._lock:
+            items = sorted(self._stats.items(),
+                           key=lambda kv: -kv[1].total)
+            for key, s in items:
+                lines.append("%-32s %8d %12.2f %12.3f %12.3f %12.3f" % (
+                    key, s.count, s.total * 1e3,
+                    s.total / s.count * 1e3 if s.count else 0.0,
+                    s.vmax * 1e3,
+                    s.vmin * 1e3 if s.count else 0.0))
+        return "\n".join(lines)
+
+    def items(self):
+        with self._lock:
+            return {k: (s.count, s.total) for k, s in self._stats.items()}
+
+
+stat_set = StatSet()
+
+
+def timer(key):
+    """``with timer("forwardBackward"): ...`` — REGISTER_TIMER analog."""
+    return stat_set.span(key)

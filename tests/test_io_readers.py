@@ -1,0 +1,263 @@
+"""io (checkpoint/inference export), reader decorators, DataFeeder,
+evaluator, lr schedulers, dataset API tests."""
+
+import os
+
+import numpy as np
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers, reader as rd, dataset, evaluator
+from paddle_tpu.data_feeder import DataFeeder, pad_batch
+
+
+def _mk_model():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        pred = layers.fc(x, 1, param_attr=ptpu.ParamAttr(name="w"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = ptpu.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
+        opt.minimize(loss, startup_program=startup)
+    return main, startup, loss, pred
+
+
+class TestIO:
+    def test_save_load_persistables_roundtrip(self, tmp_path):
+        main, startup, loss, _ = _mk_model()
+        exe = ptpu.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        for _ in range(5):
+            xb = rs.randn(16, 4).astype("float32")
+            exe.run(main, feed={"x": xb, "y": xb.sum(1, keepdims=True)},
+                    fetch_list=[loss])
+        w_before = np.asarray(ptpu.global_scope().find_var("w")).copy()
+        ptpu.io.save_persistables(exe, str(tmp_path), main)
+
+        # clobber and restore
+        ptpu.global_scope().set_var("w", np.zeros_like(w_before))
+        ptpu.io.load_persistables(exe, str(tmp_path), main)
+        np.testing.assert_array_equal(
+            np.asarray(ptpu.global_scope().find_var("w")), w_before)
+
+    def test_resume_training_is_exact(self, tmp_path):
+        """Checkpoint/resume continuity: train 5+5 == train 10 (momentum
+        state saved too) — the reference's pass-resume semantics."""
+        rs = np.random.RandomState(0)
+        batches = [(rs.randn(8, 4).astype("float32"),) for _ in range(10)]
+
+        def train(steps, resume_from=None, save_at=None):
+            with ptpu.unique_name.guard():
+                main, startup, loss, _ = _mk_model()
+            exe = ptpu.Executor()
+            with ptpu.scope_guard(ptpu.Scope()):
+                exe.run(startup)
+                if resume_from:
+                    ptpu.io.load_persistables(exe, resume_from, main)
+                for i in range(steps):
+                    xb, = batches[i if not resume_from else i + 5]
+                    exe.run(main, feed={"x": xb,
+                                        "y": xb.sum(1, keepdims=True)},
+                            fetch_list=[loss])
+                if save_at:
+                    ptpu.io.save_persistables(exe, save_at, main)
+                return np.asarray(ptpu.global_scope().find_var("w"))
+
+        w10 = train(10)
+        ckpt = str(tmp_path / "ck")
+        train(5, save_at=ckpt)
+        w5p5 = train(5, resume_from=ckpt)
+        np.testing.assert_allclose(w10, w5p5, rtol=1e-6)
+
+    def test_inference_model_roundtrip(self, tmp_path):
+        main, startup, loss, pred = _mk_model()
+        exe = ptpu.Executor()
+        exe.run(startup)
+        ptpu.io.save_inference_model(str(tmp_path), ["x"], [pred], exe,
+                                     main)
+        xb = np.random.RandomState(1).randn(4, 4).astype("float32")
+        ref, = exe.run(main, feed={"x": xb, "y": np.zeros((4, 1), "f")},
+                       fetch_list=[pred])
+
+        with ptpu.scope_guard(ptpu.Scope()):
+            prog, feeds, fetches = ptpu.io.load_inference_model(
+                str(tmp_path), exe)
+            out, = exe.run(prog, feed={"x": xb}, fetch_list=fetches)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+class TestReaders:
+    def test_decorators(self):
+        base = lambda: iter(range(10))
+        assert sorted(rd.shuffle(base, 5, seed=0)()) == list(range(10))
+        assert list(rd.firstn(base, 3)()) == [0, 1, 2]
+        assert list(rd.chain(base, base)()) == list(range(10)) * 2
+        batches = list(rd.batch(base, 3)())
+        assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+        assert list(rd.batch(base, 3, drop_last=False)())[-1] == [9]
+        assert list(rd.map_readers(lambda a: a * 2, base)()) == \
+            [i * 2 for i in range(10)]
+        assert list(rd.buffered(base, 2)()) == list(range(10))
+        comp = rd.compose(base, rd.map_readers(lambda a: a * 2, base))
+        assert list(comp()) == [(i, i * 2) for i in range(10)]
+        got = sorted(rd.xmap_readers(lambda s: s + 1, base, 2, 4)())
+        assert got == [i + 1 for i in range(10)]
+        got = list(rd.xmap_readers(lambda s: s + 1, base, 2, 4,
+                                   order=True)())
+        assert got == [i + 1 for i in range(10)]
+        c = rd.cache(base)
+        assert list(c()) == list(range(10)) == list(c())
+
+    def test_pad_batch(self):
+        seqs = [[1, 2, 3], [4], [5, 6]]
+        padded, lengths = pad_batch(seqs, pad_value=0)
+        np.testing.assert_array_equal(lengths, [3, 1, 2])
+        np.testing.assert_array_equal(padded,
+                                      [[1, 2, 3], [4, 0, 0], [5, 6, 0]])
+
+    def test_data_feeder_seq(self):
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            words = layers.data("words", shape=[None], dtype="int64")
+            length = layers.data("length", shape=[], dtype="int64")
+            label = layers.data("label", shape=[1], dtype="int64")
+        feeder = DataFeeder([(words, length), label],
+                            seq_buckets=[4, 8, 16])
+        batch = [([1, 2, 3], 0), ([4, 5], 1)]
+        feed = feeder.feed(batch)
+        assert feed["words"].shape == (2, 4)  # bucketed to 4
+        np.testing.assert_array_equal(feed["length"], [3, 2])
+        assert feed["label"].shape == (2, 1)
+
+
+class TestDatasets:
+    def test_mnist_shapes(self):
+        img, lab = next(dataset.mnist.train()())
+        assert img.shape == (784,) and 0 <= lab < 10
+
+    def test_uci_housing(self):
+        x, y = next(dataset.uci_housing.train()())
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_imdb(self):
+        ids, lab = next(dataset.imdb.train()())
+        assert isinstance(ids, list) and lab in (0, 1)
+
+    def test_wmt14(self):
+        src, trg_in, trg_out = next(dataset.wmt14.train()())
+        assert trg_in[0] == 0 and trg_out[-1] == 1
+        assert len(trg_in) == len(trg_out)
+
+    def test_deterministic(self):
+        a = [s[1] for s in list(rd.firstn(dataset.mnist.train(), 5)())]
+        b = [s[1] for s in list(rd.firstn(dataset.mnist.train(), 5)())]
+        assert a == b
+
+
+class TestEvaluatorScheduler:
+    def test_accuracy_evaluator_accumulates(self):
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            scores = layers.data("scores", shape=[4])
+            label = layers.data("label", shape=[1], dtype="int64")
+            ev = evaluator.Accuracy(scores, label)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        ev.reset()
+        # batch 1: 2/3 correct; batch 2: 1/3
+        s1 = np.eye(4)[[0, 1, 2]].astype("float32")
+        exe.run(main, feed={"scores": s1,
+                            "label": np.array([[0], [1], [3]], "int64")},
+                fetch_list=[ev.metric])
+        exe.run(main, feed={"scores": s1,
+                            "label": np.array([[0], [2], [3]], "int64")},
+                fetch_list=[ev.metric])
+        assert abs(ev.eval() - 3.0 / 6.0) < 1e-6
+        ev.reset()
+        assert ev.eval() == 0.0
+
+    def test_lr_schedulers(self):
+        opt = ptpu.optimizer.SGD(learning_rate=0.1)
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[2])
+            w = main.global_block().create_parameter(
+                name="w", shape=[2], dtype="float32",
+                initializer=ptpu.initializer.Constant(0.0))
+            sb = startup.global_block()
+            sv = sb.create_var(name="w", shape=[2], dtype="float32",
+                               persistable=True)
+            ptpu.initializer.Constant(0.0)(sv, sb)
+            loss = layers.reduce_mean(
+                layers.square(layers.elementwise_sub(x, w)))
+            opt.minimize(loss, startup_program=startup)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        sched = ptpu.lr_scheduler.ExponentialDecay(opt, decay_steps=1,
+                                                   decay_rate=0.5)
+        lr1 = sched.step()
+        assert abs(lr1 - 0.05) < 1e-9
+        lr2 = sched.step()
+        assert abs(lr2 - 0.025) < 1e-9
+        # scope var actually updated
+        v = np.asarray(ptpu.global_scope().find_var(
+            opt._lr_var.name))
+        np.testing.assert_allclose(v, [0.025])
+        pw = ptpu.lr_scheduler.PiecewiseDecay(opt, [2, 4],
+                                              [0.1, 0.01, 0.001])
+        assert pw.get_lr(1) == 0.1
+        assert pw.get_lr(3) == 0.01
+        assert pw.get_lr(9) == 0.001
+
+    def test_chunk_evaluator(self):
+        # IOB with 2 types: B0=0,I0=1,B1=2,I1=3,O=4
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            inf = layers.data("inf", shape=[6], dtype="int64")
+            lab = layers.data("lab", shape=[6], dtype="int64")
+            length = layers.data("len", shape=[], dtype="int64")
+            ev = evaluator.ChunkEvaluator(inf, lab, length,
+                                          num_chunk_types=2)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        ev.reset()
+        # label: [B0 I0 O B1 O pad]; infer: [B0 I0 O B0 O pad]
+        lab_v = np.array([[0, 1, 4, 2, 4, 4]], dtype="int64")
+        inf_v = np.array([[0, 1, 4, 0, 4, 4]], dtype="int64")
+        exe.run(main, feed={"inf": inf_v, "lab": lab_v,
+                            "len": np.array([5], "int64")})
+        p, r, f1 = ev.eval()
+        assert abs(p - 0.5) < 1e-6 and abs(r - 0.5) < 1e-6
+
+
+def test_full_pipeline_mnist():
+    """dataset -> reader decorators -> feeder -> train: the reference's
+    canonical train loop shape (trainer.py / book tests)."""
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        img = layers.data("img", shape=[784])
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(img, 64, act="relu")
+        logits = layers.fc(h, 10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits,
+                                                             label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        ptpu.optimizer.Adam(learning_rate=1e-3).minimize(
+            loss, startup_program=startup)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    train_reader = rd.batch(
+        rd.shuffle(rd.firstn(dataset.mnist.train(), 2048), 512, seed=0),
+        batch_size=64)
+    feeder = DataFeeder([layers.data("img", shape=[784],
+                                     main_program=main),
+                         layers.data("label", shape=[1], dtype="int64",
+                                     main_program=main)])
+    accs = []
+    for epoch in range(2):
+        for batch in train_reader():
+            _, a = exe.run(main, feed=feeder.feed(batch),
+                           fetch_list=[loss, acc])
+            accs.append(float(a))
+    assert np.mean(accs[-10:]) > 0.9
