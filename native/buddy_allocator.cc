@@ -1,0 +1,124 @@
+// Buddy allocator over a host staging arena.
+//
+// TPU-native equivalent of the reference memory manager
+// (paddle/memory/detail/buddy_allocator.{h,cc} + system_allocator.cc):
+// device HBM is XLA/PJRT-managed on TPU, so this arena serves the host
+// side — staging buffers for infeed batches and checkpoint IO — where the
+// reference used pinned allocations. Classic power-of-two buddy scheme:
+// O(log n) alloc/free with coalescing; 64-byte alignment for fast numpy
+// wrapping.
+//
+// C ABI for ctypes.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMinOrder = 6;  // 64-byte min block
+
+struct Arena {
+  uint8_t* base = nullptr;
+  uint32_t max_order = 0;
+  // free lists per order; offsets
+  std::vector<std::set<size_t>> free_lists;
+  std::map<size_t, uint32_t> allocated;  // offset -> order
+  std::mutex mu;
+  size_t in_use = 0;
+  size_t peak = 0;
+};
+
+uint32_t order_for(size_t size) {
+  uint32_t order = kMinOrder;
+  while ((1ull << order) < size) order++;
+  return order;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptarena_create(size_t total_bytes) {
+  uint32_t max_order = order_for(total_bytes);
+  if ((1ull << max_order) > total_bytes) max_order--;
+  Arena* a = new Arena();
+  a->base = (uint8_t*)aligned_alloc(64, 1ull << max_order);
+  if (!a->base) {
+    delete a;
+    return nullptr;
+  }
+  a->max_order = max_order;
+  a->free_lists.resize(max_order + 1);
+  a->free_lists[max_order].insert(0);
+  return a;
+}
+
+void* ptarena_alloc(void* ha, size_t size) {
+  Arena* a = (Arena*)ha;
+  if (size == 0) size = 1;
+  uint32_t want = order_for(size);
+  std::lock_guard<std::mutex> lk(a->mu);
+  // find the smallest free block >= want
+  uint32_t o = want;
+  while (o <= a->max_order && a->free_lists[o].empty()) o++;
+  if (o > a->max_order) return nullptr;  // arena exhausted
+  size_t off = *a->free_lists[o].begin();
+  a->free_lists[o].erase(a->free_lists[o].begin());
+  // split down to the wanted order
+  while (o > want) {
+    o--;
+    a->free_lists[o].insert(off + (1ull << o));  // right buddy freed
+  }
+  a->allocated[off] = want;
+  a->in_use += 1ull << want;
+  if (a->in_use > a->peak) a->peak = a->in_use;
+  return a->base + off;
+}
+
+int ptarena_free(void* ha, void* ptr) {
+  Arena* a = (Arena*)ha;
+  std::lock_guard<std::mutex> lk(a->mu);
+  size_t off = (uint8_t*)ptr - a->base;
+  auto it = a->allocated.find(off);
+  if (it == a->allocated.end()) return -1;
+  uint32_t o = it->second;
+  a->allocated.erase(it);
+  a->in_use -= 1ull << o;
+  // coalesce with buddies
+  while (o < a->max_order) {
+    size_t buddy = off ^ (1ull << o);
+    auto& fl = a->free_lists[o];
+    auto bit = fl.find(buddy);
+    if (bit == fl.end()) break;
+    fl.erase(bit);
+    off = off < buddy ? off : buddy;
+    o++;
+  }
+  a->free_lists[o].insert(off);
+  return 0;
+}
+
+size_t ptarena_in_use(void* ha) {
+  Arena* a = (Arena*)ha;
+  std::lock_guard<std::mutex> lk(a->mu);
+  return a->in_use;
+}
+
+size_t ptarena_peak(void* ha) {
+  Arena* a = (Arena*)ha;
+  std::lock_guard<std::mutex> lk(a->mu);
+  return a->peak;
+}
+
+void ptarena_destroy(void* ha) {
+  Arena* a = (Arena*)ha;
+  free(a->base);
+  delete a;
+}
+
+}  // extern "C"

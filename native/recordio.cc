@@ -1,0 +1,197 @@
+// RecordIO: chunked record container for dataset files.
+//
+// TPU-native equivalent of the reference's RecordIO dependency (the Go
+// master partitions datasets into RecordIO chunks — go/master/service.go:
+// 57-106; python/paddle/v2/master/client.py reads them). Format here:
+//   file  := chunk*
+//   chunk := "PTRC" u32 num_records u32 payload_len u32 crc32 payload
+//   payload := (u32 record_len record_bytes)*
+// Chunks are the task-dispatch granularity for the elastic master
+// (native/task_master.cc); crc32 guards torn writes on recovery.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[4] = {'P', 'T', 'R', 'C'};
+
+uint32_t crc32(const uint8_t* data, size_t len) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++)
+    c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Writer {
+  FILE* f;
+  std::vector<uint8_t> buf;
+  uint32_t nrec = 0;
+  uint32_t max_chunk;
+};
+
+struct Reader {
+  FILE* f;
+  // records of the current chunk
+  std::vector<std::vector<uint8_t>> records;
+  size_t next = 0;
+  // chunk index for seek/task dispatch
+  std::vector<long> chunk_offsets;
+};
+
+void put_u32(std::vector<uint8_t>& v, uint32_t x) {
+  v.push_back(x & 0xFF);
+  v.push_back((x >> 8) & 0xFF);
+  v.push_back((x >> 16) & 0xFF);
+  v.push_back((x >> 24) & 0xFF);
+}
+
+uint32_t get_u32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+bool flush_chunk(Writer* w) {
+  if (w->nrec == 0) return true;
+  uint8_t head[16];
+  memcpy(head, kMagic, 4);
+  uint32_t n = w->nrec, len = (uint32_t)w->buf.size();
+  uint32_t crc = crc32(w->buf.data(), w->buf.size());
+  memcpy(head + 4, &n, 4);
+  memcpy(head + 8, &len, 4);
+  memcpy(head + 12, &crc, 4);
+  if (fwrite(head, 1, 16, w->f) != 16) return false;
+  if (!w->buf.empty() &&
+      fwrite(w->buf.data(), 1, w->buf.size(), w->f) != w->buf.size())
+    return false;
+  w->buf.clear();
+  w->nrec = 0;
+  return true;
+}
+
+bool read_chunk_at(FILE* f, std::vector<std::vector<uint8_t>>* out) {
+  uint8_t head[16];
+  if (fread(head, 1, 16, f) != 16) return false;
+  if (memcmp(head, kMagic, 4) != 0) return false;
+  uint32_t n = get_u32(head + 4), len = get_u32(head + 8),
+           crc = get_u32(head + 12);
+  std::vector<uint8_t> payload(len);
+  if (len && fread(payload.data(), 1, len, f) != len) return false;
+  if (crc32(payload.data(), len) != crc) return false;
+  out->clear();
+  size_t off = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    if (off + 4 > len) return false;
+    uint32_t rl = get_u32(payload.data() + off);
+    off += 4;
+    if (off + rl > len) return false;
+    out->emplace_back(payload.begin() + off, payload.begin() + off + rl);
+    off += rl;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptrc_writer_open(const char* path, uint32_t max_chunk_bytes) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  Writer* w = new Writer();
+  w->f = f;
+  w->max_chunk = max_chunk_bytes ? max_chunk_bytes : (1u << 20);
+  return w;
+}
+
+int ptrc_writer_write(void* hw, const uint8_t* data, uint32_t len) {
+  Writer* w = (Writer*)hw;
+  put_u32(w->buf, len);
+  w->buf.insert(w->buf.end(), data, data + len);
+  w->nrec++;
+  if (w->buf.size() >= w->max_chunk) return flush_chunk(w) ? 0 : -1;
+  return 0;
+}
+
+int ptrc_writer_close(void* hw) {
+  Writer* w = (Writer*)hw;
+  bool ok = flush_chunk(w);
+  fclose(w->f);
+  delete w;
+  return ok ? 0 : -1;
+}
+
+void* ptrc_reader_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  Reader* r = new Reader();
+  r->f = f;
+  // index chunks
+  long off = ftell(f);
+  uint8_t head[16];
+  while (fread(head, 1, 16, f) == 16) {
+    if (memcmp(head, kMagic, 4) != 0) break;
+    r->chunk_offsets.push_back(off);
+    uint32_t len = get_u32(head + 8);
+    if (fseek(f, len, SEEK_CUR) != 0) break;
+    off = ftell(f);
+  }
+  fseek(f, 0, SEEK_SET);
+  return r;
+}
+
+int ptrc_reader_num_chunks(void* hr) {
+  return (int)((Reader*)hr)->chunk_offsets.size();
+}
+
+// Load chunk i; returns record count or -1.
+int ptrc_reader_load_chunk(void* hr, int i) {
+  Reader* r = (Reader*)hr;
+  if (i < 0 || (size_t)i >= r->chunk_offsets.size()) return -1;
+  if (fseek(r->f, r->chunk_offsets[i], SEEK_SET) != 0) return -1;
+  if (!read_chunk_at(r->f, &r->records)) return -1;
+  r->next = 0;
+  return (int)r->records.size();
+}
+
+// Next record in the loaded chunk: returns length, copies up to cap bytes.
+int ptrc_reader_next(void* hr, uint8_t* out, uint32_t cap) {
+  Reader* r = (Reader*)hr;
+  if (r->next >= r->records.size()) return -1;
+  const auto& rec = r->records[r->next++];
+  uint32_t n = (uint32_t)rec.size();
+  if (out && cap >= n) memcpy(out, rec.data(), n);
+  return (int)n;
+}
+
+// Peek length of the next record without consuming.
+int ptrc_reader_peek_len(void* hr) {
+  Reader* r = (Reader*)hr;
+  if (r->next >= r->records.size()) return -1;
+  return (int)r->records[r->next].size();
+}
+
+void ptrc_reader_close(void* hr) {
+  Reader* r = (Reader*)hr;
+  fclose(r->f);
+  delete r;
+}
+
+}  // extern "C"

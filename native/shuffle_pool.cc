@@ -1,0 +1,95 @@
+// Shuffling prefetch pool: the native data-loader stage.
+//
+// TPU-native equivalent of PyDataProvider2's C++-side sample pool
+// (reference paddle/gserver/dataproviders/PyDataProvider2.cpp:195,511:
+// background loading thread + pool with shuffle + min_pool_size) and the
+// async double-buffer path (DataProvider.h:375). Producer threads push
+// serialized samples; a consumer pops uniformly-shuffled samples once the
+// pool holds min_pool_size, overlapping host IO with device steps.
+//
+// C ABI for ctypes.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <vector>
+
+namespace {
+
+struct Pool {
+  std::vector<std::vector<uint8_t>> items;
+  std::mutex mu;
+  std::condition_variable cv_pop, cv_push;
+  size_t min_pool, max_pool;
+  bool closed = false;
+  std::mt19937 rng;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptpool_create(uint32_t min_pool, uint32_t max_pool, uint32_t seed) {
+  Pool* p = new Pool();
+  p->min_pool = min_pool;
+  p->max_pool = max_pool ? max_pool : (min_pool * 4 + 1024);
+  p->rng.seed(seed);
+  return p;
+}
+
+// Blocks while the pool is full. Returns 0, or -1 if closed.
+int ptpool_push(void* hp, const uint8_t* data, uint32_t len) {
+  Pool* p = (Pool*)hp;
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_push.wait(lk, [&] { return p->items.size() < p->max_pool ||
+                                   p->closed; });
+  if (p->closed) return -1;
+  p->items.emplace_back(data, data + len);
+  p->cv_pop.notify_one();
+  return 0;
+}
+
+// Producer signals end of stream; consumers drain the remainder.
+void ptpool_close(void* hp) {
+  Pool* p = (Pool*)hp;
+  std::lock_guard<std::mutex> lk(p->mu);
+  p->closed = true;
+  p->cv_pop.notify_all();
+  p->cv_push.notify_all();
+}
+
+// Pop a uniformly random sample once >= min_pool items are buffered (or
+// the stream closed). Returns the record length on success, -1 when
+// drained, or -(len+1) WITHOUT consuming when cap is too small (caller
+// grows the buffer and retries).
+int ptpool_pop(void* hp, uint8_t* out, uint32_t cap) {
+  Pool* p = (Pool*)hp;
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_pop.wait(lk, [&] {
+    return p->items.size() >= p->min_pool || p->closed;
+  });
+  if (p->items.empty()) return -1;
+  std::uniform_int_distribution<size_t> dist(0, p->items.size() - 1);
+  size_t i = dist(p->rng);
+  uint32_t n = (uint32_t)p->items[i].size();
+  if (!out || cap < n) return -((int)n + 1);
+  std::swap(p->items[i], p->items.back());
+  std::vector<uint8_t> rec = std::move(p->items.back());
+  p->items.pop_back();
+  p->cv_push.notify_one();
+  lk.unlock();
+  memcpy(out, rec.data(), n);
+  return (int)n;
+}
+
+int ptpool_size(void* hp) {
+  Pool* p = (Pool*)hp;
+  std::lock_guard<std::mutex> lk(p->mu);
+  return (int)p->items.size();
+}
+
+void ptpool_destroy(void* hp) { delete (Pool*)hp; }
+
+}  // extern "C"

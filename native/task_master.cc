@@ -1,0 +1,300 @@
+// Elastic task-queue master: the control-plane daemon.
+//
+// TPU-native equivalent of the reference's Go master (go/master/service.go:
+// GetTask :368, TaskFinished :411, TaskFailed :455, timeout requeue :341,
+// state snapshot/recovery :166-229): datasets are partitioned into tasks
+// (e.g. RecordIO chunks, native/recordio.cc); workers lease tasks with a
+// timeout; failed/timed-out tasks are requeued until a failure budget is
+// exhausted; all state is snapshotted to disk on every mutation so a
+// restarted master resumes exactly (single-coordinator stand-in for the
+// etcd store).
+//
+// Wire protocol: newline-delimited text over TCP.
+//   ADD <id> <payload...>         -> OK
+//   GET <worker>                  -> TASK <id> <epoch> <payload> | NONE | ALLDONE
+//   FIN <id> <epoch>              -> OK | STALE
+//   FAIL <id> <epoch>             -> OK | STALE | DISCARDED
+//   RESET                         -> OK           (new pass: done -> todo)
+//   STATS                         -> STATS <todo> <pending> <done> <failed>
+//   PING                          -> PONG
+//   SHUTDOWN                      -> OK
+//
+// Usage: task_master <port> <snapshot_path> [timeout_sec] [failure_max]
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Task {
+  std::string id;
+  std::string payload;
+  int epoch = 0;
+  int failures = 0;
+  Clock::time_point deadline{};
+  std::string owner;
+};
+
+struct Master {
+  std::mutex mu;
+  std::deque<std::string> todo;            // task ids
+  std::map<std::string, Task> tasks;       // id -> task
+  std::vector<std::string> pending;        // leased ids
+  std::vector<std::string> done;
+  std::vector<std::string> failed;         // discarded (budget exhausted)
+  std::string snapshot_path;
+  int timeout_sec = 30;
+  int failure_max = 3;
+  std::atomic<bool> stop{false};
+
+  void snapshot_locked() {
+    if (snapshot_path.empty()) return;
+    std::string tmp = snapshot_path + ".tmp";
+    std::ofstream f(tmp, std::ios::trunc);
+    for (auto& kv : tasks) {
+      const Task& t = kv.second;
+      const char* state = "todo";
+      for (auto& id : pending)
+        if (id == t.id) state = "pending";
+      for (auto& id : done)
+        if (id == t.id) state = "done";
+      for (auto& id : failed)
+        if (id == t.id) state = "failed";
+      // pending tasks persist as todo: after a master restart the lease
+      // is void and the task must be re-dispatched (go/master recovery)
+      if (strcmp(state, "pending") == 0) state = "todo";
+      f << state << " " << t.epoch << " " << t.failures << " " << t.id
+        << " " << t.payload << "\n";
+    }
+    f.close();
+    rename(tmp.c_str(), snapshot_path.c_str());
+  }
+
+  void recover() {
+    std::ifstream f(snapshot_path);
+    if (!f.good()) return;
+    std::string line;
+    while (std::getline(f, line)) {
+      std::istringstream ss(line);
+      std::string state, id;
+      Task t;
+      ss >> state >> t.epoch >> t.failures >> id;
+      std::getline(ss, t.payload);
+      if (!t.payload.empty() && t.payload[0] == ' ')
+        t.payload.erase(0, 1);
+      t.id = id;
+      tasks[id] = t;
+      if (state == "done")
+        done.push_back(id);
+      else if (state == "failed")
+        failed.push_back(id);
+      else
+        todo.push_back(id);
+    }
+  }
+
+  void requeue_locked(const std::string& id) {
+    Task& t = tasks[id];
+    t.epoch++;
+    t.failures++;
+    pending.erase(std::remove(pending.begin(), pending.end(), id),
+                  pending.end());
+    if (t.failures > failure_max) {
+      failed.push_back(id);
+    } else {
+      todo.push_back(id);
+    }
+  }
+
+  void check_timeouts() {
+    std::lock_guard<std::mutex> lk(mu);
+    auto now = Clock::now();
+    std::vector<std::string> expired;
+    for (auto& id : pending)
+      if (tasks[id].deadline < now) expired.push_back(id);
+    for (auto& id : expired) requeue_locked(id);
+    if (!expired.empty()) snapshot_locked();
+  }
+
+  std::string handle(const std::string& line) {
+    std::istringstream ss(line);
+    std::string cmd;
+    ss >> cmd;
+    std::lock_guard<std::mutex> lk(mu);
+    if (cmd == "PING") return "PONG";
+    if (cmd == "ADD") {
+      Task t;
+      ss >> t.id;
+      std::getline(ss, t.payload);
+      if (!t.payload.empty() && t.payload[0] == ' ')
+        t.payload.erase(0, 1);
+      if (tasks.count(t.id)) return "DUP";
+      tasks[t.id] = t;
+      todo.push_back(t.id);
+      snapshot_locked();
+      return "OK";
+    }
+    if (cmd == "GET") {
+      std::string worker;
+      ss >> worker;
+      if (todo.empty()) {
+        if (pending.empty()) return "ALLDONE";
+        return "NONE";  // stragglers in flight; caller retries
+      }
+      std::string id = todo.front();
+      todo.pop_front();
+      Task& t = tasks[id];
+      t.owner = worker;
+      t.deadline = Clock::now() + std::chrono::seconds(timeout_sec);
+      pending.push_back(id);
+      snapshot_locked();
+      std::ostringstream out;
+      out << "TASK " << id << " " << t.epoch << " " << t.payload;
+      return out.str();
+    }
+    if (cmd == "FIN" || cmd == "FAIL") {
+      std::string id;
+      int epoch;
+      ss >> id >> epoch;
+      auto it = tasks.find(id);
+      if (it == tasks.end() || it->second.epoch != epoch)
+        return "STALE";  // lease superseded (go/master Epoch check)
+      bool leased = false;
+      for (auto& pid : pending) leased |= (pid == id);
+      if (!leased) return "STALE";
+      if (cmd == "FIN") {
+        pending.erase(std::remove(pending.begin(), pending.end(), id),
+                      pending.end());
+        done.push_back(id);
+        snapshot_locked();
+        return "OK";
+      }
+      requeue_locked(id);
+      snapshot_locked();
+      bool discarded = false;
+      for (auto& fid : failed) discarded |= (fid == id);
+      return discarded ? "DISCARDED" : "OK";
+    }
+    if (cmd == "RESET") {
+      for (auto& id : done) {
+        tasks[id].epoch++;
+        todo.push_back(id);
+      }
+      done.clear();
+      snapshot_locked();
+      return "OK";
+    }
+    if (cmd == "STATS") {
+      std::ostringstream out;
+      out << "STATS " << todo.size() << " " << pending.size() << " "
+          << done.size() << " " << failed.size();
+      return out.str();
+    }
+    if (cmd == "SHUTDOWN") {
+      stop = true;
+      return "OK";
+    }
+    return "ERR unknown command";
+  }
+};
+
+void serve_conn(Master* m, int fd) {
+  std::string buf;
+  char tmp[4096];
+  while (!m->stop) {
+    ssize_t n = recv(fd, tmp, sizeof(tmp), 0);
+    if (n <= 0) break;
+    buf.append(tmp, n);
+    size_t pos;
+    while ((pos = buf.find('\n')) != std::string::npos) {
+      std::string line = buf.substr(0, pos);
+      buf.erase(0, pos + 1);
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      std::string resp = m->handle(line) + "\n";
+      if (send(fd, resp.data(), resp.size(), MSG_NOSIGNAL) < 0) {
+        close(fd);
+        return;
+      }
+    }
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr,
+            "usage: task_master <port> <snapshot_path> [timeout_sec] "
+            "[failure_max]\n");
+    return 2;
+  }
+  Master m;
+  int port = atoi(argv[1]);
+  m.snapshot_path = argv[2];
+  if (argc > 3) m.timeout_sec = atoi(argv[3]);
+  if (argc > 4) m.failure_max = atoi(argv[4]);
+  m.recover();
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(srv, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  listen(srv, 64);
+  // report the actually-bound port (port 0 = ephemeral) on stdout
+  socklen_t alen = sizeof(addr);
+  getsockname(srv, (sockaddr*)&addr, &alen);
+  printf("LISTENING %d\n", ntohs(addr.sin_port));
+  fflush(stdout);
+
+  std::thread timeouts([&m] {
+    while (!m.stop) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(200));
+      m.check_timeouts();
+    }
+  });
+
+  std::vector<std::thread> conns;
+  while (!m.stop) {
+    fd_set fds;
+    FD_ZERO(&fds);
+    FD_SET(srv, &fds);
+    timeval tv{0, 200000};
+    int r = select(srv + 1, &fds, nullptr, nullptr, &tv);
+    if (r <= 0) continue;
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    conns.emplace_back(serve_conn, &m, fd);
+  }
+  for (auto& t : conns)
+    if (t.joinable()) t.join();
+  timeouts.join();
+  close(srv);
+  return 0;
+}

@@ -1,0 +1,14 @@
+"""Distributed runtime: elastic control plane + multi-host launch.
+
+Replaces the reference's distribution stack per SURVEY §5.8:
+* data-plane collectives: jax.sharding + SPMD (see paddle_tpu.parallel) —
+  not here; XLA emits them.
+* control plane: native/task_master.cc (C++ daemon) with the Python client
+  in master.py — go/master parity (task leases, timeout requeue, failure
+  budget, snapshot recovery).
+* multi-host bring-up: launch.py wraps jax.distributed.initialize (the
+  jax.distributed runtime replaces pserver endpoints/etcd discovery).
+"""
+
+from .master import MasterServer, MasterClient, ElasticDataDispatcher  # noqa
+from .launch import init_multihost  # noqa: F401

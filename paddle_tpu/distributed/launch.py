@@ -1,0 +1,33 @@
+"""Multi-host bring-up.
+
+Replaces the reference's cluster bootstrap (pserver endpoints lists,
+etcd discovery, trainer_id/num_gradient_servers gflags —
+``paddle/utils/Flags.cpp``, ``go/pserver/etcd_client.go``) with the JAX
+distributed runtime: one coordinator address, process_id/num_processes,
+then global devices participate in one SPMD mesh over ICI/DCN.
+"""
+
+import os
+
+__all__ = ["init_multihost"]
+
+
+def init_multihost(coordinator_address=None, num_processes=None,
+                   process_id=None):
+    """Initialize jax.distributed from args or the standard env vars
+    (PADDLE_TPU_COORDINATOR / PADDLE_TPU_NUM_PROCS / PADDLE_TPU_PROC_ID).
+    On a single process this is a no-op. Returns (process_id,
+    num_processes)."""
+    import jax
+    coordinator_address = coordinator_address or \
+        os.environ.get("PADDLE_TPU_COORDINATOR")
+    if coordinator_address is None:
+        return 0, 1
+    num_processes = int(num_processes or
+                        os.environ.get("PADDLE_TPU_NUM_PROCS", "1"))
+    process_id = int(process_id if process_id is not None else
+                     os.environ.get("PADDLE_TPU_PROC_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+    return process_id, num_processes

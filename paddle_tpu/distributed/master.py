@@ -1,0 +1,160 @@
+"""Client + launcher for the elastic task master (native/task_master.cc).
+
+go/master parity (SURVEY §5.3): GetTask/TaskFinished/TaskFailed RPCs with
+task epochs, timeout requeue, failure budget, and disk-snapshot recovery.
+The reference's cgo master client (python/paddle/v2/master/client.py) maps
+to MasterClient; cloud_reader maps to ElasticDataDispatcher.reader().
+"""
+
+import os
+import socket
+import subprocess
+import time
+
+from .. import native
+
+__all__ = ["MasterServer", "MasterClient", "ElasticDataDispatcher"]
+
+
+class MasterServer:
+    """Spawns the C++ task_master daemon on localhost."""
+
+    def __init__(self, snapshot_path, port=0, timeout_sec=30,
+                 failure_max=3):
+        binary = native.task_master_binary()
+        self.proc = subprocess.Popen(
+            [binary, str(port), snapshot_path, str(timeout_sec),
+             str(failure_max)],
+            stdout=subprocess.PIPE, text=True)
+        line = self.proc.stdout.readline().strip()
+        if not line.startswith("LISTENING"):
+            raise RuntimeError("task_master failed to start: %r" % line)
+        self.port = int(line.split()[1])
+        self.snapshot_path = snapshot_path
+
+    def stop(self, graceful=True):
+        if self.proc.poll() is not None:
+            return
+        if graceful:
+            try:
+                MasterClient(self.port).shutdown()
+                self.proc.wait(timeout=5)
+                return
+            except Exception:
+                pass
+        self.proc.kill()
+        self.proc.wait()
+
+    def kill(self):
+        """Hard-kill (for failover tests)."""
+        self.proc.kill()
+        self.proc.wait()
+
+
+class MasterClient:
+    def __init__(self, port, host="127.0.0.1", retries=3):
+        self.addr = (host, port)
+        self.retries = retries
+        self._sock = None
+
+    def _connect(self):
+        s = socket.create_connection(self.addr, timeout=10)
+        self._file = s.makefile("r")
+        self._sock = s
+
+    def _call(self, line):
+        for attempt in range(self.retries):
+            try:
+                if self._sock is None:
+                    self._connect()
+                self._sock.sendall((line + "\n").encode())
+                resp = self._file.readline()
+                if resp:
+                    return resp.strip()
+            except OSError:
+                pass
+            self._sock = None
+            time.sleep(0.2 * (attempt + 1))
+        raise ConnectionError("master unreachable at %s:%d" % self.addr)
+
+    def ping(self):
+        return self._call("PING") == "PONG"
+
+    def add_task(self, task_id, payload=""):
+        return self._call("ADD %s %s" % (task_id, payload))
+
+    def get_task(self, worker_id="w0"):
+        """Returns (task_id, epoch, payload) or None (retry later) or
+        'ALLDONE'."""
+        resp = self._call("GET %s" % worker_id)
+        if resp == "NONE":
+            return None
+        if resp == "ALLDONE":
+            return "ALLDONE"
+        parts = resp.split(" ", 3)
+        return (parts[1], int(parts[2]),
+                parts[3] if len(parts) > 3 else "")
+
+    def task_finished(self, task_id, epoch):
+        return self._call("FIN %s %d" % (task_id, epoch))
+
+    def task_failed(self, task_id, epoch):
+        return self._call("FAIL %s %d" % (task_id, epoch))
+
+    def reset_pass(self):
+        return self._call("RESET")
+
+    def stats(self):
+        parts = self._call("STATS").split()
+        return {"todo": int(parts[1]), "pending": int(parts[2]),
+                "done": int(parts[3]), "failed": int(parts[4])}
+
+    def shutdown(self):
+        return self._call("SHUTDOWN")
+
+
+class ElasticDataDispatcher:
+    """Dataset-as-task-queue: RecordIO chunks dispatched through the
+    master; a worker's reader pulls chunk leases and yields samples
+    (reference cloud_reader + master GetTask loop)."""
+
+    def __init__(self, client, recordio_path, worker_id="w0"):
+        self.client = client
+        self.path = recordio_path
+        self.worker_id = worker_id
+
+    def register_dataset(self):
+        from ..reader import recordio as rio
+        n = rio.num_chunks(self.path)
+        for i in range(n):
+            self.client.add_task("chunk-%d" % i, str(i))
+        return n
+
+    def reader(self, poll_interval=0.2, deserialize=None):
+        """Yield samples from leased chunks until the pass completes.
+        Chunk completion is reported per-lease; a crash mid-chunk means
+        the chunk is re-dispatched after the timeout — at-least-once, as
+        in the reference."""
+        from ..reader import recordio as rio
+        import pickle
+        de = deserialize or pickle.loads
+
+        def gen():
+            while True:
+                task = self.client.get_task(self.worker_id)
+                if task == "ALLDONE":
+                    return
+                if task is None:
+                    time.sleep(poll_interval)
+                    continue
+                task_id, epoch, payload = task
+                chunk = int(payload)
+                try:
+                    for sample in rio.chunked_reader(
+                            self.path, [chunk], deserialize=de)():
+                        yield sample
+                except Exception:
+                    self.client.task_failed(task_id, epoch)
+                    continue
+                self.client.task_finished(task_id, epoch)
+        return gen

@@ -1,0 +1,95 @@
+"""Loader for the native (C++) components — builds them on first use.
+
+The reference shipped its native layer prebuilt by CMake; here a make
+invocation compiles the small dependency-free C++ sources in native/ into
+shared libraries (ctypes, no pybind11 in this image) and the task_master
+daemon binary.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_ROOT, "native")
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "build")
+_lock = threading.Lock()
+_libs = {}
+
+
+def build_native():
+    with _lock:
+        subprocess.run(["make", "-s", "-C", _NATIVE_DIR], check=True)
+    return _BUILD_DIR
+
+
+def _ensure(name):
+    path = os.path.join(_BUILD_DIR, name)
+    if not os.path.exists(path):
+        build_native()
+    return path
+
+
+def load_lib(stem):
+    """Load lib<stem>.so, building if needed."""
+    with _lock:
+        if stem in _libs:
+            return _libs[stem]
+    path = _ensure("lib%s.so" % stem)
+    lib = ctypes.CDLL(path)
+    with _lock:
+        _libs[stem] = lib
+    return lib
+
+
+def task_master_binary():
+    return _ensure("task_master")
+
+
+def recordio_lib():
+    lib = load_lib("recordio")
+    lib.ptrc_writer_open.restype = ctypes.c_void_p
+    lib.ptrc_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+    lib.ptrc_writer_write.argtypes = [ctypes.c_void_p,
+                                      ctypes.c_char_p, ctypes.c_uint32]
+    lib.ptrc_writer_close.argtypes = [ctypes.c_void_p]
+    lib.ptrc_reader_open.restype = ctypes.c_void_p
+    lib.ptrc_reader_open.argtypes = [ctypes.c_char_p]
+    lib.ptrc_reader_num_chunks.argtypes = [ctypes.c_void_p]
+    lib.ptrc_reader_load_chunk.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptrc_reader_next.argtypes = [ctypes.c_void_p,
+                                     ctypes.c_char_p, ctypes.c_uint32]
+    lib.ptrc_reader_peek_len.argtypes = [ctypes.c_void_p]
+    lib.ptrc_reader_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def shuffle_pool_lib():
+    lib = load_lib("shuffle_pool")
+    lib.ptpool_create.restype = ctypes.c_void_p
+    lib.ptpool_create.argtypes = [ctypes.c_uint32, ctypes.c_uint32,
+                                  ctypes.c_uint32]
+    lib.ptpool_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint32]
+    lib.ptpool_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_uint32]
+    lib.ptpool_close.argtypes = [ctypes.c_void_p]
+    lib.ptpool_size.argtypes = [ctypes.c_void_p]
+    lib.ptpool_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def arena_lib():
+    lib = load_lib("buddy_allocator")
+    lib.ptarena_create.restype = ctypes.c_void_p
+    lib.ptarena_create.argtypes = [ctypes.c_size_t]
+    lib.ptarena_alloc.restype = ctypes.c_void_p
+    lib.ptarena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.ptarena_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.ptarena_in_use.restype = ctypes.c_size_t
+    lib.ptarena_in_use.argtypes = [ctypes.c_void_p]
+    lib.ptarena_peak.restype = ctypes.c_size_t
+    lib.ptarena_peak.argtypes = [ctypes.c_void_p]
+    lib.ptarena_destroy.argtypes = [ctypes.c_void_p]
+    return lib

@@ -55,16 +55,22 @@ def _conv3d(ctx):
 
 @register_op("conv2d_transpose")
 def _conv2d_transpose(ctx):
+    """Fractionally-strided conv (reference conv2d_transpose_op semantics:
+    out = (in-1)*stride - 2*pad + dilation*(k-1) + 1). Implemented as
+    conv_general_dilated with lhs_dilation=stride and a spatially-flipped,
+    IO-swapped kernel — the exact gradient-of-conv construction."""
     x, w = ctx.input("Input"), ctx.input("Filter")  # w: [in, out, kh, kw]
-    strides = _pair(ctx.attr("strides", [1, 1]))
-    pads = _pair(ctx.attr("paddings", [0, 0]))
-    dilations = _pair(ctx.attr("dilations", [1, 1]))
-    out = jax.lax.conv_transpose(
-        x, w, strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-        rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True)
+    sh, sw = _pair(ctx.attr("strides", [1, 1]))
+    ph, pw = _pair(ctx.attr("paddings", [0, 0]))
+    dh, dw = _pair(ctx.attr("dilations", [1, 1]))
+    kh, kw = w.shape[2], w.shape[3]
+    w_fb = jnp.transpose(w, (1, 0, 2, 3))[:, :, ::-1, ::-1]
+    out = jax.lax.conv_general_dilated(
+        x, w_fb, window_strides=(1, 1),
+        padding=[(dh * (kh - 1) - ph, dh * (kh - 1) - ph),
+                 (dw * (kw - 1) - pw, dw * (kw - 1) - pw)],
+        lhs_dilation=(sh, sw), rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
     return {"Output": out}
 
 
