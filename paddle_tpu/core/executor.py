@@ -315,7 +315,9 @@ class Executor:
         read_t = tuple(sorted(read - written))
 
         from .. import config as _config
+        from .. import parallel as _parallel
         precision = _config.resolve_matmul_precision()
+        strategy = self.strategy
 
         def fn(state_rw, state_ro, feed):
             env = {}
@@ -323,11 +325,15 @@ class Executor:
             env.update(state_rw)
             env.update(feed)
             trace = _TraceState(needs_vjp)
-            if precision is not None:
-                with jax.default_matmul_precision(precision):
+            prev = _parallel.set_current_strategy(strategy)
+            try:
+                if precision is not None:
+                    with jax.default_matmul_precision(precision):
+                        run_block(block, env, trace)
+                else:
                     run_block(block, env, trace)
-            else:
-                run_block(block, env, trace)
+            finally:
+                _parallel.set_current_strategy(prev)
             new_state = {n: env[n] for n in written_t if n in env}
             fetches = [_lookup(env, n, None, block) for n in fetch_names]
             return new_state, fetches

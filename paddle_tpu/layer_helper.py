@@ -42,6 +42,15 @@ class LayerHelper:
         init = attr.initializer or default_initializer or \
             attr.default_initializer(is_bias)
         dtype = convert_dtype(dtype)
+        gblock = self.main_program.global_block()
+        existing = gblock.vars.get(name)
+        if existing is not None:
+            # weight sharing via a repeated ParamAttr name (fluid idiom)
+            if tuple(existing.shape) != tuple(shape):
+                raise ValueError(
+                    "parameter %r reused with shape %s != %s"
+                    % (name, shape, existing.shape))
+            return existing
         # main program: Parameter in global block
         param = self.block.create_parameter(
             name=name, shape=shape, dtype=dtype, initializer=init,

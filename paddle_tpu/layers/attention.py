@@ -1,0 +1,72 @@
+"""Transformer layers: multi-head attention, encoder layer, positional
+embedding. (Capability upgrade over the reference's additive-attention NMT
+demo; ring_axis enables sequence parallelism over the mesh.)"""
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from ..initializer import NormalInitializer
+from . import nn as _nn
+from . import ops as _ops
+
+__all__ = ["multi_head_attention", "transformer_encoder_layer",
+           "positional_encoding"]
+
+
+def multi_head_attention(queries, keys, values, d_model, num_heads,
+                         causal=False, key_length=None, ring_axis=None,
+                         param_attr=None, name=None, **kwargs):
+    """Full MHA with input/output projections. queries/keys/values:
+    [B, T, D]. ``ring_axis``: mesh axis name for ring (sequence-parallel)
+    attention."""
+    helper = LayerHelper("multi_head_attention", name=name, **kwargs)
+    q = _nn.fc(queries, d_model, num_flatten_dims=2, bias_attr=False,
+               param_attr=param_attr, **kwargs)
+    k = _nn.fc(keys, d_model, num_flatten_dims=2, bias_attr=False,
+               param_attr=param_attr, **kwargs)
+    v = _nn.fc(values, d_model, num_flatten_dims=2, bias_attr=False,
+               param_attr=param_attr, **kwargs)
+    inputs = {"Q": [q.name], "K": [k.name], "V": [v.name]}
+    if key_length is not None:
+        inputs["KeyLength"] = [key_length.name]
+    ctx_out = helper.create_tmp_variable(queries.dtype)
+    helper.append_op(type="multihead_attention", inputs=inputs,
+                     outputs={"Out": [ctx_out.name]},
+                     attrs={"num_heads": num_heads, "causal": causal,
+                            "ring_axis": ring_axis})
+    return _nn.fc(ctx_out, d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=param_attr, **kwargs)
+
+
+def transformer_encoder_layer(x, d_model, num_heads, d_ff, causal=False,
+                              key_length=None, ring_axis=None,
+                              dropout_prob=0.0, is_test=False, name=None,
+                              **kwargs):
+    """Pre-norm transformer block: x + MHA(LN(x)); x + FFN(LN(x))."""
+    ln1 = _nn.layer_norm(x, begin_norm_axis=2, **kwargs)
+    att = multi_head_attention(ln1, ln1, ln1, d_model, num_heads,
+                               causal=causal, key_length=key_length,
+                               ring_axis=ring_axis, **kwargs)
+    if dropout_prob:
+        att = _nn.dropout(att, dropout_prob, is_test=is_test, **kwargs)
+    x = _nn.elementwise_add(x, att, **kwargs)
+    ln2 = _nn.layer_norm(x, begin_norm_axis=2, **kwargs)
+    ff = _nn.fc(ln2, d_ff, num_flatten_dims=2, act="gelu", **kwargs)
+    ff = _nn.fc(ff, d_model, num_flatten_dims=2, **kwargs)
+    if dropout_prob:
+        ff = _nn.dropout(ff, dropout_prob, is_test=is_test, **kwargs)
+    return _nn.elementwise_add(x, ff, **kwargs)
+
+
+def positional_encoding(x, max_len=None, name=None, **kwargs):
+    """Learned positional embedding added to [B, T, D] input."""
+    helper = LayerHelper("pos_encoding", name=name, **kwargs)
+    t, d = x.shape[1], x.shape[2]
+    pos = helper.create_parameter(
+        None, shape=[t, d], dtype=x.dtype,
+        default_initializer=NormalInitializer(0.0, 0.02))
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="elementwise_add",
+                     inputs={"X": [x.name], "Y": [pos.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": 1})
+    return out

@@ -160,12 +160,13 @@ def dynamic_lstm(input, size, length=None, param_attr=None, bias_attr=None,
     helper = LayerHelper("dynamic_lstm", **kwargs)
     w = helper.create_parameter(param_attr, shape=[size, 4 * size],
                                 dtype=input.dtype)
-    nbias = 7 * size if use_peepholes else 4 * size
-    bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
-                                   shape=[1, nbias], dtype=input.dtype,
-                                   is_bias=True)
-    inputs = {"Input": [input.name], "Weight": [w.name],
-              "Bias": [bias.name]}
+    inputs = {"Input": [input.name], "Weight": [w.name]}
+    if bias_attr is not False:
+        nbias = 7 * size if use_peepholes else 4 * size
+        bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                       shape=[1, nbias],
+                                       dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [bias.name]
     if length is not None:
         inputs["Length"] = [length.name]
     if h0 is not None:
@@ -192,11 +193,12 @@ def dynamic_gru(input, size, length=None, param_attr=None, bias_attr=None,
     helper = LayerHelper("dynamic_gru", **kwargs)
     w = helper.create_parameter(param_attr, shape=[size, 3 * size],
                                 dtype=input.dtype)
-    bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
-                                   shape=[1, 3 * size], dtype=input.dtype,
-                                   is_bias=True)
-    inputs = {"Input": [input.name], "Weight": [w.name],
-              "Bias": [bias.name]}
+    inputs = {"Input": [input.name], "Weight": [w.name]}
+    if bias_attr is not False:
+        bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                       shape=[1, 3 * size],
+                                       dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [bias.name]
     if length is not None:
         inputs["Length"] = [length.name]
     if h0 is not None:

@@ -1,0 +1,43 @@
+"""Transformer language model / sequence classifier.
+
+Flagship long-context model: causal LM over padded token batches, built
+from layers/attention.py; with ``ring_axis`` + a 'sp'-bearing mesh the
+attention sequence dimension shards across devices (ring attention).
+"""
+
+from .. import layers
+from ..layers.attention import (transformer_encoder_layer,
+                                positional_encoding)
+
+__all__ = ["transformer_lm"]
+
+
+def transformer_lm(tokens, labels, vocab_size, d_model=128, num_heads=4,
+                   d_ff=256, num_layers=2, ring_axis=None,
+                   dropout_prob=0.0, is_test=False, length=None):
+    """tokens/labels: [B, T] ids (labels = tokens shifted). Returns
+    (loss, logits)."""
+    emb = layers.embedding(tokens, size=[vocab_size, d_model],
+                           param_attr="tok_embedding")
+    x = positional_encoding(emb)
+    for i in range(num_layers):
+        x = transformer_encoder_layer(
+            x, d_model, num_heads, d_ff, causal=True,
+            ring_axis=ring_axis, dropout_prob=dropout_prob,
+            is_test=is_test)
+    x = layers.layer_norm(x, begin_norm_axis=2)
+    logits = layers.fc(x, vocab_size, num_flatten_dims=2,
+                       bias_attr=False)
+    t = tokens.shape[1]
+    flat_logits = layers.reshape(logits, [-1, vocab_size])
+    flat_labels = layers.reshape(labels, [-1, 1])
+    tok_loss = layers.softmax_with_cross_entropy(flat_logits, flat_labels)
+    tok_loss = layers.reshape(tok_loss, [-1, t])
+    if length is not None:
+        mask = layers.sequence_mask(length, maxlen=t)
+        masked = layers.elementwise_mul(tok_loss, mask)
+        loss = layers.elementwise_div(layers.reduce_sum(masked),
+                                      layers.reduce_sum(mask))
+    else:
+        loss = layers.mean(tok_loss)
+    return loss, logits

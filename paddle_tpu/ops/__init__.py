@@ -15,5 +15,7 @@ from . import (  # noqa: F401
     metric_ops,
     sequence_ops,
     seq2seq_ops,
+    control_flow_ops,
+    attention_ops,
     misc_ops,
 )

@@ -1,0 +1,54 @@
+"""Attention ops: fused multi-head attention + ring (sequence-parallel)
+attention.
+
+The reference predates transformers — attention capability is an upgrade
+(its closest analog is the NMT demo's additive attention built from
+primitive layers). Here attention is a first-class fused op so XLA maps it
+onto the MXU as two batched matmuls + softmax, and the ring variant
+(parallel/ring_attention.py) scales the sequence dimension across the mesh
+(SURVEY §2.3 gap: SP/CP).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .. import parallel
+
+
+@register_op("multihead_attention")
+def _multihead_attention(ctx):
+    """Q,K,V: [B, T, H*D] packed; attrs num_heads, causal; optional
+    KeyLength [B] masking padded keys. Out: [B, T, H*D]."""
+    q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")
+    nh = ctx.attr("num_heads")
+    causal = ctx.attr("causal", False)
+    b, tq, dm = q.shape
+    tk = k.shape[1]
+    hd = dm // nh
+    qh = q.reshape(b, tq, nh, hd)
+    kh = k.reshape(b, tk, nh, hd)
+    vh = v.reshape(b, tk, nh, hd)
+
+    strategy = parallel.current_strategy()
+    use_ring = ctx.attr("ring_axis") and strategy is not None and \
+        ctx.attr("ring_axis") in strategy.mesh.axis_names and tq == tk
+    if use_ring:
+        out = parallel.ring_attention(qh, kh, vh, strategy.mesh,
+                                      axis_name=ctx.attr("ring_axis"),
+                                      causal=causal)
+        return {"Out": out.reshape(b, tq, dm)}
+
+    s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
+                   preferred_element_type=jnp.float32) * (hd ** -0.5)
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+    if causal:
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        s = jnp.where(mask[None, None], s, neg)
+    if ctx.has_input("KeyLength"):
+        klen = ctx.input("KeyLength").reshape(-1)
+        kmask = jnp.arange(tk)[None, :] < klen[:, None]
+        s = jnp.where(kmask[:, None, None, :], s, neg)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+    return {"Out": out.reshape(b, tq, dm)}

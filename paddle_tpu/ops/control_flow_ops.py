@@ -1,0 +1,138 @@
+"""Control-flow ops executing sub-blocks inside the XLA computation.
+
+Parity with the reference's sub-block ops (``operators/while_op.cc:35-64``
+step-scope re-execution, ``recurrent_op.cc``, ``conditional_block_op.cc``;
+legacy RecurrentGradientMachine, SURVEY B.3), TPU-first:
+
+* static_rnn  -> ONE ``lax.scan`` over the traced step block. Because the
+  whole thing is a pure JAX function, jax.vjp differentiates THROUGH the
+  scan — training works with no recurrent_grad machinery (the reference
+  needed per-frame cloned sub-networks with scatter/gather agents).
+* while      -> ``lax.while_loop`` (forward-only; generation/decoding).
+* cond       -> ``lax.cond`` over two traced branch blocks.
+
+The trip structure must be static-shape (XLA): step inputs are padded
+[batch, time, ...] tensors; while-carried vars keep their shapes.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _run_sub_block(block, env):
+    from ..core.executor import run_block, _TraceState
+    run_block(block, env, _TraceState(set()))
+    return env
+
+
+def _rnn_infer_shape(op, block):
+    program = block.program
+    sub = program.blocks[op.attrs["sub_block"]]
+    t = None
+    for name in op.inputs.get("StepInputs", []):
+        v = block.var_or_none(name)
+        if v is not None and v.shape is not None and len(v.shape) >= 2:
+            t = v.shape[1]
+            batch = v.shape[0]
+            break
+    else:
+        batch, t = -1, None
+    for out_name, sub_name in zip(op.outputs.get("Outputs", []),
+                                  op.attrs["output_vars"]):
+        sv = sub.var_or_none(sub_name)
+        ov = block.var_or_none(out_name)
+        if sv is not None and ov is not None and sv.shape is not None:
+            ov.shape = (batch, t) + tuple(sv.shape[1:])
+            ov.dtype = sv.dtype
+    for out_name, (prev, upd) in zip(op.outputs.get("FinalStates", []),
+                                     op.attrs["state_vars"]):
+        sv = sub.var_or_none(upd)
+        ov = block.var_or_none(out_name)
+        if sv is not None and ov is not None:
+            ov.shape = sv.shape
+            ov.dtype = sv.dtype
+
+
+@register_op("static_rnn", infer_shape=_rnn_infer_shape)
+def _static_rnn(ctx):
+    program = ctx.block.program
+    sub = program.blocks[ctx.attr("sub_block")]
+    step_in_names = ctx.attr("step_input_vars")
+    state_vars = ctx.attr("state_vars")        # [(prev, updated)]
+    out_names = ctx.attr("output_vars")
+    cap_names = ctx.attr("captured_vars")
+
+    captured = dict(zip(cap_names, ctx.inputs("Captured")))
+    xs = [jnp.swapaxes(v, 0, 1) for v in ctx.inputs("StepInputs")]
+    init = tuple(ctx.inputs("InitStates"))
+    is_reverse = ctx.attr("is_reverse", False)
+
+    def body(carry, x_ts):
+        env = dict(captured)
+        env.update({pv: c for (pv, _), c in zip(state_vars, carry)})
+        env.update(dict(zip(step_in_names, x_ts)))
+        _run_sub_block(sub, env)
+        new_carry = tuple(env[upd] for _, upd in state_vars)
+        outs = tuple(env[n] for n in out_names)
+        return new_carry, outs
+
+    final, outs = jax.lax.scan(body, init, tuple(xs),
+                               reverse=bool(is_reverse))
+    return {"Outputs": [jnp.swapaxes(o, 0, 1) for o in outs],
+            "FinalStates": list(final)}
+
+
+@register_op("while", skip_eval_shape=True)
+def _while(ctx):
+    """Run the sub-block until the condition var becomes False. Carried =
+    the vars the sub-block writes (+ cond); captured = read-only outer
+    vars. Forward-only (lax.while_loop has no transpose rule — training
+    loops use static_rnn/scan instead, as on any XLA backend)."""
+    program = ctx.block.program
+    sub = program.blocks[ctx.attr("sub_block")]
+    carried_names = ctx.attr("carried_vars")
+    cap_names = ctx.attr("captured_vars")
+    cond_name = ctx.attr("cond_var")
+    captured = dict(zip(cap_names, ctx.inputs("Captured")))
+    init = tuple(ctx.inputs("Carried"))
+    cond_idx = carried_names.index(cond_name)
+
+    def cond_fn(carry):
+        return jnp.reshape(carry[cond_idx], ()).astype(jnp.bool_)
+
+    def body_fn(carry):
+        env = dict(captured)
+        env.update(dict(zip(carried_names, carry)))
+        _run_sub_block(sub, env)
+        return tuple(env[n] for n in carried_names)
+
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    return {"CarriedOut": list(final)}
+
+
+@register_op("cond", skip_eval_shape=True)
+def _cond(ctx):
+    """lax.cond over two traced branch blocks (reference
+    conditional_block_op / IfElse). Both branches must write the same
+    output vars with matching shapes."""
+    program = ctx.block.program
+    true_b = program.blocks[ctx.attr("true_block")]
+    false_b = program.blocks[ctx.attr("false_block")]
+    cap_names = ctx.attr("captured_vars")
+    captured = dict(zip(cap_names, ctx.inputs("Captured")))
+    pred = jnp.reshape(ctx.input("Cond"), ()).astype(jnp.bool_)
+
+    def branch(block, out_names):
+        def fn(cap):
+            env = dict(cap)
+            _run_sub_block(block, env)
+            return tuple(env[n] for n in out_names)
+        return fn
+
+    outs = jax.lax.cond(pred,
+                        branch(true_b, ctx.attr("true_outputs")),
+                        branch(false_b, ctx.attr("false_outputs")),
+                        captured)
+    return {"Out": list(outs)}

@@ -25,7 +25,24 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["Mesh", "P", "make_mesh", "DistStrategy", "DataParallel"]
+__all__ = ["Mesh", "P", "make_mesh", "DistStrategy", "DataParallel",
+           "ring_attention", "dense_attention", "current_strategy",
+           "set_current_strategy"]
+
+_current_strategy = None
+
+
+def set_current_strategy(strategy):
+    """Trace-time strategy context (set by the Executor so mesh-aware ops
+    like ring attention can find the mesh)."""
+    global _current_strategy
+    prev = _current_strategy
+    _current_strategy = strategy
+    return prev
+
+
+def current_strategy():
+    return _current_strategy
 
 
 def make_mesh(axes, devices=None):
@@ -83,6 +100,9 @@ class DistStrategy:
     def shard_state(self, name, array):
         return jax.device_put(array,
                               self.state_sharding(name, np.ndim(array)))
+
+
+from .ring_attention import ring_attention, dense_attention  # noqa: E402
 
 
 def DataParallel(mesh=None, n_devices=None, param_rules=None):

@@ -1,0 +1,185 @@
+"""Control-flow tests: StaticRNN (training through scan), While, cond
+(reference test_while_op / recurrent-group equivalence tests,
+SURVEY §4 RNN group equivalence)."""
+
+import numpy as np
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+from paddle_tpu.layers.control_flow import StaticRNN, While, cond
+
+
+def sigmoid(x):
+    return 1 / (1 + np.exp(-x))
+
+
+class TestStaticRNN:
+    def test_cumsum_rnn_matches_numpy(self):
+        """Memory carries a running sum: out[t] = sum(x[:t+1])."""
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[5, 3])  # [N, T=5, D=3]
+            zero = layers.fill_constant_batch_size_like(
+                x, shape=[-1, 3], dtype="float32", value=0.0)
+            rnn = StaticRNN()
+            with rnn.step():
+                x_t = rnn.step_input(x)
+                acc = rnn.memory(init=zero)
+                new_acc = layers.elementwise_add(acc, x_t)
+                rnn.update_memory(acc, new_acc)
+                rnn.step_output(new_acc)
+            out = rnn()
+        exe = ptpu.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(2, 5, 3).astype("float32")
+        got, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(got, np.cumsum(xv, axis=1), rtol=1e-5)
+
+    def test_rnn_trains_through_scan(self):
+        """fc-RNN built with StaticRNN learns a simple last-step task —
+        gradients flow through lax.scan via vjp."""
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[6, 4])
+            y = layers.data("y", shape=[1])
+            h0 = layers.fill_constant_batch_size_like(
+                x, shape=[-1, 8], dtype="float32", value=0.0)
+            rnn = StaticRNN()
+            with rnn.step():
+                x_t = rnn.step_input(x)
+                h_prev = rnn.memory(init=h0)
+                h = layers.fc([x_t, h_prev], 8, act="tanh")
+                rnn.update_memory(h_prev, h)
+                rnn.step_output(h)
+            seq = rnn()
+            last = layers.sequence_pool(seq, "last")
+            pred = layers.fc(last, 1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            ptpu.optimizer.Adam(learning_rate=5e-3).minimize(
+                loss, startup_program=startup)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        losses = []
+        for i in range(200):
+            xv = rs.randn(32, 6, 4).astype("float32")
+            yv = xv.sum(axis=(1, 2), keepdims=False).reshape(-1, 1) * 0.1
+            out, = exe.run(main, feed={"x": xv, "y": yv},
+                           fetch_list=[loss])
+            losses.append(float(out))
+        assert losses[-1] < 0.1 * losses[0], (losses[0], losses[-1])
+
+    def test_rnn_equivalence_with_dynamic_lstm(self):
+        """StaticRNN implementing an LSTM step == the fused dynamic_lstm
+        op (the reference's RNN-group equivalence test pattern,
+        test_RecurrentGradientMachine)."""
+        b, t, h = 2, 4, 3
+        rs = np.random.RandomState(3)
+        xv = (rs.randn(b, t, 4 * h) * 0.4).astype("float32")
+        wv = (rs.randn(h, 4 * h) * 0.3).astype("float32")
+
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[t, 4 * h])
+            w = main.global_block().create_parameter(
+                name="w_shared", shape=[h, 4 * h], dtype="float32",
+                initializer=ptpu.initializer.Constant(0.0))
+            sblock = startup.global_block()
+            sv = sblock.create_var(name="w_shared", shape=[h, 4 * h],
+                                   dtype="float32", persistable=True)
+            ptpu.initializer.Constant(0.0)(sv, sblock)
+            # fused op path
+            bias = layers.fill_constant([1, 4 * h], "float32", 0.0)
+            hidden, cell = layers.dynamic_lstm(
+                x, h, param_attr="w_shared", bias_attr=False)
+        # the layer created its own bias? we passed bias_attr=False ->
+        # dynamic_lstm requires Bias param; check signature: it creates w
+        # via param_attr name "w_shared" (shared) and bias param.
+        exe = ptpu.Executor()
+        exe.run(startup)
+        ptpu.global_scope().set_var("w_shared", wv)
+        fused, = exe.run(main, feed={"x": xv}, fetch_list=[hidden])
+
+        # StaticRNN path: same math step by step
+        main2, startup2 = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main2, startup2):
+            x2 = layers.data("x", shape=[t, 4 * h])
+            w2 = main2.global_block().create_parameter(
+                name="w_shared", shape=[h, 4 * h], dtype="float32",
+                initializer=ptpu.initializer.Constant(0.0))
+            s2 = startup2.global_block()
+            sv2 = s2.create_var(name="w_shared", shape=[h, 4 * h],
+                                dtype="float32", persistable=True)
+            ptpu.initializer.Constant(0.0)(sv2, s2)
+            h0 = layers.fill_constant_batch_size_like(
+                x2, shape=[-1, h], dtype="float32", value=0.0)
+            c0 = layers.fill_constant_batch_size_like(
+                x2, shape=[-1, h], dtype="float32", value=0.0)
+            rnn = StaticRNN()
+            with rnn.step():
+                x_t = rnn.step_input(x2)
+                hp = rnn.memory(init=h0)
+                cp = rnn.memory(init=c0)
+                gates = layers.elementwise_add(
+                    x_t, layers.mul(hp, w2))
+                gi = layers.slice(gates, [1], [0], [h])
+                gf = layers.slice(gates, [1], [h], [2 * h])
+                gc = layers.slice(gates, [1], [2 * h], [3 * h])
+                go = layers.slice(gates, [1], [3 * h], [4 * h])
+                c_new = layers.elementwise_add(
+                    layers.elementwise_mul(layers.sigmoid(gf), cp),
+                    layers.elementwise_mul(layers.sigmoid(gi),
+                                           layers.tanh(gc)))
+                h_new = layers.elementwise_mul(layers.sigmoid(go),
+                                               layers.tanh(c_new))
+                rnn.update_memory(hp, h_new)
+                rnn.update_memory(cp, c_new)
+                rnn.step_output(h_new)
+            out2 = rnn()
+        exe2 = ptpu.Executor()
+        with ptpu.scope_guard(ptpu.Scope()):
+            exe2.run(startup2)
+            ptpu.global_scope().set_var("w_shared", wv)
+            manual, = exe2.run(main2, feed={"x": xv}, fetch_list=[out2])
+        np.testing.assert_allclose(fused, manual, rtol=2e-4, atol=1e-5)
+
+
+class TestWhile:
+    def test_while_counts(self):
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            i = layers.fill_constant([1], "int32", 0)
+            n = layers.fill_constant([1], "int32", 7)
+            acc = layers.fill_constant([1], "float32", 0.0)
+            cond_v = layers.less_than(i, n)
+            w = While(cond_v)
+            with w.block():
+                acc2 = layers.increment(acc, 2.5, in_place=False)
+                layers.assign(acc2, acc)
+                i2 = layers.increment(i, 1, in_place=False)
+                layers.assign(i2, i)
+                layers.assign(layers.less_than(i2, n), cond_v)
+        exe = ptpu.Executor()
+        got_acc, got_i = exe.run(main, fetch_list=[acc, i])
+        np.testing.assert_allclose(got_acc, [17.5])
+        np.testing.assert_array_equal(got_i, [7])
+
+
+class TestCond:
+    def test_cond_branches(self):
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            flag = layers.data("flag", shape=[], dtype="bool",
+                               append_batch_size=False)
+            out = cond(flag,
+                       lambda: layers.scale(x, 2.0),
+                       lambda: layers.scale(x, -1.0))
+        exe = ptpu.Executor()
+        xv = np.ones((2, 4), dtype="float32")
+        a, = exe.run(main, feed={"x": xv, "flag": np.array(True)},
+                     fetch_list=[out])
+        b, = exe.run(main, feed={"x": xv, "flag": np.array(False)},
+                     fetch_list=[out])
+        np.testing.assert_allclose(a, 2 * xv)
+        np.testing.assert_allclose(b, -xv)
