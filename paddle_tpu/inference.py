@@ -1,0 +1,40 @@
+"""Inference engine.
+
+Parity with reference ``paddle/inference`` (InferenceEngine::
+LoadInferenceModel + Execute, ``inference.h:23-45``) and v2
+``paddle.v2.inference.Inference.infer``. Loads an exported model dir and
+runs the pruned program as one jitted XLA computation.
+"""
+
+import numpy as np
+
+from . import io as _io
+from .core.executor import Executor
+from .core.scope import Scope, scope_guard
+
+__all__ = ["InferenceEngine", "infer"]
+
+
+class InferenceEngine:
+    def __init__(self, model_dir, place=None):
+        self.exe = Executor(place=place)
+        self.scope = Scope()
+        with scope_guard(self.scope):
+            (self.program, self.feed_names,
+             self.fetch_names) = _io.load_inference_model(model_dir,
+                                                          self.exe)
+
+    def run(self, feed):
+        """feed: {name: array} (or positional list matching feed_names)."""
+        if isinstance(feed, (list, tuple)):
+            feed = dict(zip(self.feed_names, feed))
+        with scope_guard(self.scope):
+            return self.exe.run(self.program, feed=feed,
+                                fetch_list=self.fetch_names)
+
+
+def infer(model_dir, feed, place=None):
+    """One-shot helper (v2 paddle.infer parity)."""
+    engine = InferenceEngine(model_dir, place=place)
+    outs = engine.run(feed)
+    return outs[0] if len(outs) == 1 else outs

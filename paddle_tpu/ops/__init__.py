@@ -17,5 +17,6 @@ from . import (  # noqa: F401
     seq2seq_ops,
     control_flow_ops,
     attention_ops,
+    crf_ctc_ops,
     misc_ops,
 )

@@ -1,0 +1,159 @@
+"""Trainer: the event-driven training loop.
+
+Parity with the reference's two trainer surfaces:
+* legacy C++ Trainer / TrainerInternal hot loop (``paddle/trainer/
+  Trainer.cpp:265,406``, ``TrainerInternal.cpp:66-171``): pass loop, batch
+  loop, evaluators, per-pass checkpoints, stat timers;
+* v2 Python ``paddle.v2.trainer.SGD.train`` (``python/paddle/v2/
+  trainer.py:37,137``): reader + event_handler protocol with
+  BeginPass/EndPass/BeginIteration/EndIteration events.
+
+TPU-native: each batch is ONE donated XLA computation (fwd+bwd+update);
+the reader is wrapped in a host-side prefetch buffer to overlap input with
+device steps (the async double-buffer DataProvider analog).
+"""
+
+import numpy as np
+
+from . import io as _io
+from . import reader as _reader
+from .core.executor import Executor
+from .core.framework import default_main_program, default_startup_program
+from .core.scope import global_scope
+from .utils.stat import timer, stat_set
+
+__all__ = ["Trainer", "BeginPass", "EndPass", "BeginIteration",
+           "EndIteration"]
+
+
+class BeginPass:
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass:
+    def __init__(self, pass_id, metrics=None):
+        self.pass_id = pass_id
+        self.metrics = metrics or {}
+
+
+class BeginIteration:
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration:
+    def __init__(self, pass_id, batch_id, step_id, metrics):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.step_id = step_id
+        self.metrics = metrics
+
+    @property
+    def cost(self):
+        return self.metrics.get("loss")
+
+
+class Trainer:
+    def __init__(self, loss, optimizer=None, feeder=None, metrics=None,
+                 main_program=None, startup_program=None, strategy=None,
+                 checkpoint_dir=None, checkpoint_every_n_steps=None,
+                 scheduler=None, place=None):
+        """metrics: {name: Variable} fetched each batch alongside loss.
+        feeder: DataFeeder (or None — reader yields feed dicts directly).
+        """
+        self.loss = loss
+        self.main_program = main_program or default_main_program()
+        self.startup_program = startup_program or \
+            default_startup_program()
+        self.exe = Executor(place=place, strategy=strategy)
+        self.feeder = feeder
+        self.metrics = dict(metrics or {})
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every_n_steps
+        self.scheduler = scheduler
+        self.step_id = 0
+        self._initialized = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def startup(self):
+        if self._initialized:
+            return
+        self.exe.run(self.startup_program)
+        if self.checkpoint_dir:
+            step = _io.load_checkpoint(self.exe, self.checkpoint_dir,
+                                       self.main_program)
+            if step is not None:
+                self.step_id = step
+        self._initialized = True
+
+    def _fetches(self):
+        names = ["loss"] + sorted(self.metrics)
+        vars_ = [self.loss] + [self.metrics[k] for k in sorted(
+            self.metrics)]
+        return names, vars_
+
+    def train_batch(self, batch):
+        """One donated-step train batch; returns {metric: value}."""
+        self.startup()
+        feed = self.feeder.feed(batch) if self.feeder else batch
+        names, vars_ = self._fetches()
+        with timer("trainOneBatch"):
+            vals = self.exe.run(self.main_program, feed=feed,
+                                fetch_list=vars_)
+        self.step_id += 1
+        if self.scheduler is not None:
+            self.scheduler.step()
+        if self.checkpoint_dir and self.checkpoint_every and \
+                self.step_id % self.checkpoint_every == 0:
+            with timer("saveCheckpoint"):
+                _io.save_checkpoint(self.exe, self.checkpoint_dir,
+                                    self.step_id, self.main_program)
+        return dict(zip(names, [np.asarray(v).item()
+                                if np.asarray(v).size == 1 else
+                                np.asarray(v) for v in vals]))
+
+    def train(self, reader, num_passes=1, event_handler=None,
+              prefetch=8):
+        """Pass/batch loop with events (v2 SGD.train parity)."""
+        self.startup()
+        event_handler = event_handler or (lambda e: None)
+        for pass_id in range(num_passes):
+            event_handler(BeginPass(pass_id))
+            batched = _reader.buffered(reader, prefetch) if prefetch \
+                else reader
+            last_metrics = {}
+            for batch_id, batch in enumerate(batched()):
+                event_handler(BeginIteration(pass_id, batch_id))
+                metrics = self.train_batch(batch)
+                last_metrics = metrics
+                event_handler(EndIteration(pass_id, batch_id,
+                                           self.step_id, metrics))
+            if self.checkpoint_dir:
+                _io.save_checkpoint(self.exe, self.checkpoint_dir,
+                                    self.step_id, self.main_program)
+            event_handler(EndPass(pass_id, last_metrics))
+
+    def test(self, reader, test_program, fetch_dict):
+        """Average fetches over a test reader (Tester parity)."""
+        self.startup()
+        names = sorted(fetch_dict)
+        vars_ = [fetch_dict[k] for k in names]
+        totals = {n: 0.0 for n in names}
+        count = 0
+        for batch in reader():
+            feed = self.feeder.feed(batch) if self.feeder else batch
+            vals = self.exe.run(test_program, feed=feed,
+                                fetch_list=vars_)
+            for n, v in zip(names, vals):
+                totals[n] += float(np.asarray(v).mean())
+            count += 1
+        return {n: totals[n] / max(count, 1) for n in names}
+
+    def save_inference_model(self, dirname, feed_names, fetch_vars):
+        _io.save_inference_model(dirname, feed_names, fetch_vars,
+                                 self.exe, self.main_program)
+
+    def report(self):
+        return stat_set.report()

@@ -1,0 +1,201 @@
+"""CRF / CTC / edit-distance tests against brute-force references
+(reference test_linear_chain_crf_op / test_warpctc_op /
+test_edit_distance_op patterns)."""
+
+import itertools
+
+import numpy as np
+
+from op_test import OpTestHarness
+
+
+def crf_brute_force(em, w, labels, length):
+    """Enumerate all paths for tiny instances."""
+    start, stop, trans = w[0], w[1], w[2:]
+    c = em.shape[1]
+
+    def score(path):
+        s = start[path[0]] + em[0, path[0]]
+        for i in range(1, len(path)):
+            s += trans[path[i - 1], path[i]] + em[i, path[i]]
+        s += stop[path[-1]]
+        return s
+
+    logz = np.logaddexp.reduce(
+        [score(p) for p in itertools.product(range(c), repeat=length)])
+    return logz - score(tuple(labels[:length]))
+
+
+class TestCRF:
+    def test_nll_matches_brute_force(self):
+        rs = np.random.RandomState(0)
+        n, t, c = 3, 4, 3
+        em = rs.randn(n, t, c).astype("float32")
+        w = rs.randn(c + 2, c).astype("float32") * 0.5
+        label = rs.randint(0, c, (n, t)).astype("int64")
+        length = np.array([4, 2, 3], dtype="int64")
+        tst = OpTestHarness("linear_chain_crf",
+                            {"Emission": em, "Label": label,
+                             "Transition": w, "Length": length},
+                            output_slots={"LogLikelihood": 1})
+        tst._build()
+        out, = tst.run()
+        for i in range(n):
+            expect = crf_brute_force(em[i], w, label[i], int(length[i]))
+            np.testing.assert_allclose(out[i, 0], expect, rtol=1e-4,
+                                       atol=1e-4)
+
+    def test_decoding_matches_brute_force(self):
+        rs = np.random.RandomState(1)
+        n, t, c = 2, 4, 3
+        em = rs.randn(n, t, c).astype("float32")
+        w = rs.randn(c + 2, c).astype("float32") * 0.5
+        length = np.array([4, 3], dtype="int64")
+        tst = OpTestHarness("crf_decoding",
+                            {"Emission": em, "Transition": w,
+                             "Length": length},
+                            output_slots={"ViterbiPath": 1})
+        tst._build()
+        path, = tst.run()
+        start, stop, trans = w[0], w[1], w[2:]
+        for i in range(n):
+            li = int(length[i])
+            best, best_p = -1e30, None
+            for p in itertools.product(range(c), repeat=li):
+                s = start[p[0]] + em[i, 0, p[0]]
+                for j in range(1, li):
+                    s += trans[p[j - 1], p[j]] + em[i, j, p[j]]
+                s += stop[p[-1]]
+                if s > best:
+                    best, best_p = s, p
+            np.testing.assert_array_equal(path[i, :li], best_p)
+
+    def test_crf_trains(self):
+        """CRF gradient flows: NLL decreases with gradient steps."""
+        rs = np.random.RandomState(2)
+        n, t, c = 8, 5, 4
+        em = rs.randn(n, t, c).astype("float32")
+        w = (rs.randn(c + 2, c) * 0.1).astype("float32")
+        label = rs.randint(0, c, (n, t)).astype("int64")
+        length = np.full(n, t, dtype="int64")
+        tst = OpTestHarness("linear_chain_crf",
+                            {"Emission": em, "Label": label,
+                             "Transition": w, "Length": length},
+                            output_slots={"LogLikelihood": 1})
+        tst.check_grad([("Emission", 0), ("Transition", 0)],
+                       output_names=["out_LogLikelihood_0"],
+                       max_relative_error=0.02)
+
+
+def ctc_brute_force(logp, labels, blank=0):
+    """Sum over all alignments for tiny instances."""
+    t, c = logp.shape
+    total = None
+    for path in itertools.product(range(c), repeat=t):
+        # collapse
+        out = []
+        prev = None
+        for s in path:
+            if s != blank and s != prev:
+                out.append(s)
+            prev = s
+        if out == list(labels):
+            s = sum(logp[i, path[i]] for i in range(t))
+            total = s if total is None else np.logaddexp(total, s)
+    return -total
+
+
+class TestCTC:
+    def test_loss_matches_brute_force(self):
+        rs = np.random.RandomState(0)
+        n, t, c, l = 2, 4, 3, 2
+        logits = rs.randn(n, t, c).astype("float32")
+        label = np.array([[1, 2], [2, 1]], dtype="int64")
+        tst = OpTestHarness(
+            "warpctc",
+            {"Logits": logits, "Label": label,
+             "LogitsLength": np.array([4, 4], "int64"),
+             "LabelLength": np.array([2, 2], "int64")},
+            attrs={"blank": 0}, output_slots={"Loss": 1})
+        tst._build()
+        out, = tst.run()
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        for i in range(n):
+            expect = ctc_brute_force(logp[i], label[i])
+            np.testing.assert_allclose(out[i, 0], expect, rtol=1e-4,
+                                       atol=1e-4)
+
+    def test_variable_lengths(self):
+        rs = np.random.RandomState(1)
+        logits = rs.randn(2, 5, 4).astype("float32")
+        label = np.array([[1, 3, 0], [2, 0, 0]], dtype="int64")
+        tst = OpTestHarness(
+            "warpctc",
+            {"Logits": logits, "Label": label,
+             "LogitsLength": np.array([5, 3], "int64"),
+             "LabelLength": np.array([2, 1], "int64")},
+            attrs={"blank": 0}, output_slots={"Loss": 1})
+        tst._build()
+        out, = tst.run()
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        np.testing.assert_allclose(
+            out[0, 0], ctc_brute_force(logp[0, :5], [1, 3]), rtol=1e-4)
+        np.testing.assert_allclose(
+            out[1, 0], ctc_brute_force(logp[1, :3], [2]), rtol=1e-4)
+
+    def test_ctc_grad(self):
+        rs = np.random.RandomState(2)
+        logits = rs.randn(2, 4, 3).astype("float32")
+        label = np.array([[1, 2], [2, 2]], dtype="int64")
+        OpTestHarness(
+            "warpctc",
+            {"Logits": logits, "Label": label,
+             "LogitsLength": np.array([4, 4], "int64"),
+             "LabelLength": np.array([2, 2], "int64")},
+            attrs={"blank": 0}, output_slots={"Loss": 1}).check_grad(
+            [("Logits", 0)], output_names=["out_Loss_0"],
+            max_relative_error=0.02)
+
+    def test_ctc_align(self):
+        x = np.array([[0, 1, 1, 0, 2, 2, 0], [3, 3, 0, 0, 0, 0, 0]],
+                     dtype="int64")
+        length = np.array([7, 2], dtype="int64")
+        tst = OpTestHarness("ctc_align",
+                            {"Input": x, "Length": length},
+                            attrs={"blank": 0},
+                            output_slots={"Output": 1, "OutputLength": 1})
+        tst._build()
+        out, out_len = tst.run()
+        np.testing.assert_array_equal(out[0, :2], [1, 2])
+        np.testing.assert_array_equal(out_len, [2, 1])
+
+
+class TestEditDistance:
+    def test_known_distances(self):
+        hyp = np.array([[1, 2, 3, 0], [1, 1, 0, 0]], dtype="int64")
+        ref = np.array([[1, 3, 3], [2, 2, 2]], dtype="int64")
+        tst = OpTestHarness(
+            "edit_distance",
+            {"Hyps": hyp, "Refs": ref,
+             "HypsLength": np.array([3, 2], "int64"),
+             "RefsLength": np.array([3, 3], "int64")},
+            attrs={"normalized": False},
+            output_slots={"Out": 1, "SequenceNum": 1})
+        tst._build()
+        out, _ = tst.run()
+        # [1,2,3] vs [1,3,3]: 1 substitution; [1,1] vs [2,2,2]: 3
+        np.testing.assert_allclose(out.ravel(), [1.0, 3.0])
+
+    def test_normalized(self):
+        hyp = np.array([[5, 6]], dtype="int64")
+        ref = np.array([[5, 6, 7, 8]], dtype="int64")
+        tst = OpTestHarness(
+            "edit_distance",
+            {"Hyps": hyp, "Refs": ref,
+             "HypsLength": np.array([2], "int64"),
+             "RefsLength": np.array([4], "int64")},
+            attrs={"normalized": True},
+            output_slots={"Out": 1, "SequenceNum": 1})
+        tst._build()
+        out, _ = tst.run()
+        np.testing.assert_allclose(out.ravel(), [0.5])

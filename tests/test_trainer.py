@@ -1,0 +1,86 @@
+"""Trainer / inference-engine end-to-end tests (reference trainer tests +
+book pipeline)."""
+
+import numpy as np
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers, reader as rd, dataset
+from paddle_tpu.trainer import Trainer, EndIteration, EndPass
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.inference import InferenceEngine
+
+
+def _build():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        img = layers.data("img", shape=[784])
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(img, 64, act="relu")
+        logits = layers.fc(h, 10)
+        prob = layers.softmax(logits)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(prob, label)
+        opt = ptpu.optimizer.Adam(learning_rate=1e-3)
+        opt.minimize(loss, startup_program=startup)
+    return main, startup, loss, acc, prob, img, label
+
+
+def test_trainer_event_loop_and_inference(tmp_path):
+    main, startup, loss, acc, prob, img, label = _build()
+    feeder = DataFeeder([img, label])
+    trainer = Trainer(loss, metrics={"acc": acc}, feeder=feeder,
+                      main_program=main, startup_program=startup,
+                      checkpoint_dir=str(tmp_path / "ckpt"))
+    events = {"iters": 0, "passes": 0, "last_acc": 0.0}
+
+    def handler(e):
+        if isinstance(e, EndIteration):
+            events["iters"] += 1
+            events["last_acc"] = e.metrics["acc"]
+        elif isinstance(e, EndPass):
+            events["passes"] += 1
+
+    train_reader = rd.batch(rd.firstn(dataset.mnist.train(), 1024), 64)
+    trainer.train(train_reader, num_passes=3, event_handler=handler)
+    assert events["passes"] == 3
+    assert events["iters"] == 3 * 16
+    assert events["last_acc"] > 0.9
+    assert "trainOneBatch" in trainer.report()
+
+    # inference export + reload
+    trainer.save_inference_model(str(tmp_path / "model"), ["img"],
+                                 [prob])
+    engine = InferenceEngine(str(tmp_path / "model"))
+    xb = np.stack([s[0] for s in
+                   rd.firstn(dataset.mnist.test(), 32)()])
+    yb = np.array([s[1] for s in
+                   rd.firstn(dataset.mnist.test(), 32)()])
+    out, = engine.run({"img": xb})
+    assert out.shape == (32, 10)
+    pred = out.argmax(1)
+    assert (pred == yb).mean() > 0.8
+
+    # checkpoint resume: a fresh trainer picks up the step counter
+    with ptpu.scope_guard(ptpu.Scope()):
+        with ptpu.unique_name.guard():
+            # rebuild with same names
+            pass
+    t2 = Trainer(loss, feeder=feeder, main_program=main,
+                 startup_program=startup,
+                 checkpoint_dir=str(tmp_path / "ckpt"))
+    with ptpu.scope_guard(ptpu.Scope()):
+        t2.startup()
+        assert t2.step_id == 48
+
+
+def test_trainer_test_loop():
+    main, startup, loss, acc, prob, img, label = _build()
+    feeder = DataFeeder([img, label])
+    trainer = Trainer(loss, metrics={"acc": acc}, feeder=feeder,
+                      main_program=main, startup_program=startup)
+    train_reader = rd.batch(rd.firstn(dataset.mnist.train(), 1024), 64)
+    trainer.train(train_reader, num_passes=2)
+    test_reader = rd.batch(rd.firstn(dataset.mnist.test(), 256), 64)
+    res = trainer.test(test_reader, main, {"acc": acc, "loss": loss})
+    assert res["acc"] > 0.8
