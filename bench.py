@@ -25,7 +25,7 @@ def main():
 
     platform = jax.devices()[0].platform
     on_accel = platform != "cpu"
-    batch = 64 if on_accel else 4
+    batch = 256 if on_accel else 4
     res = 224 if on_accel else 32
     depth = 50 if on_accel else 20
     steps = 20 if on_accel else 3
