@@ -51,9 +51,18 @@ def _lookup(env, name, op, block):
 class _TraceState:
     """Per-trace mutable state shared across ops in one block execution."""
 
-    def __init__(self, needs_vjp):
+    def __init__(self, needs_vjp, nan_guards=None):
         self.vjp_cache = {}   # id(fwd_op) -> (vjp_fn, flat_out_values)
         self.needs_vjp = needs_vjp
+        # When not None: dict collecting per-op finiteness predicates
+        # ("op#i:type:var" -> scalar bool). The reference scans every op's
+        # outputs under FLAGS_check_nan_inf (framework/executor.cc:120-128);
+        # under jit we can't raise mid-trace, so we emit the predicates into
+        # the computation and the host checks them after the step. Covers
+        # the main block and static_rnn sub-blocks (AND-reduced over time,
+        # see control_flow_ops); while/cond (forward-only generation paths)
+        # are checked at their op outputs only.
+        self.nan_guards = nan_guards
 
 
 def _gather_inputs(op, env, block):
@@ -90,7 +99,8 @@ def _execute_forward_op(op, env, block, trace):
             vals = {slot: list(lst) for slot, lst in values.items()}
             for (slot, i), a in zip(in_slots, args):
                 vals[slot][i] = a
-            ctx = registry.ExecContext(op, vals, rng_key=rng_key, block=block)
+            ctx = registry.ExecContext(op, vals, rng_key=rng_key,
+                                       block=block, trace=trace)
             result = registry.normalize_outputs(op, opdef.compute(ctx))
             return [result.get(slot, [None] * (i + 1))[i] if
                     i < len(result.get(slot, [])) else None
@@ -103,7 +113,8 @@ def _execute_forward_op(op, env, block, trace):
             if i < len(names) and val is not None and names[i] != EMPTY_VAR:
                 env[names[i]] = val
     else:
-        ctx = registry.ExecContext(op, values, rng_key=rng_key, block=block)
+        ctx = registry.ExecContext(op, values, rng_key=rng_key,
+                                   block=block, trace=trace)
         result = registry.normalize_outputs(op, opdef.compute(ctx))
         _write_outputs(op, env, result)
 
@@ -140,11 +151,19 @@ def _execute_vjp_grad(op, env, block, trace):
 
 def run_block(block, env, trace):
     """Trace every op of ``block`` against ``env`` (name -> traced value)."""
-    for op in block.ops:
+    for i, op in enumerate(block.ops):
         if op.type == "vjp_grad":
             _execute_vjp_grad(op, env, block, trace)
         else:
             _execute_forward_op(op, env, block, trace)
+        if trace.nan_guards is not None:
+            for name in op.output_names():
+                val = env.get(name)
+                if val is not None and \
+                        jnp.issubdtype(getattr(val, "dtype", None),
+                                       jnp.floating):
+                    key = "op#%d:%s:%s" % (i, op.type, name)
+                    trace.nan_guards[key] = jnp.isfinite(val).all()
 
 
 def _block_io(block):
@@ -206,14 +225,18 @@ class Executor:
             arr = jnp.asarray(value, dtype=dtype)
             feed_arrays[name] = arr
 
+        from .. import config as _config
+        check_nan_inf = bool(_config.get_flag("check_nan_inf"))
         feed_sig = tuple(sorted((n, tuple(a.shape), str(a.dtype))
                                 for n, a in feed_arrays.items()))
-        key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               bool(donate_state), id(self.strategy))
+        key = (program._uid, program._version, feed_sig, tuple(fetch_names),
+               bool(donate_state),
+               self.strategy._uid if self.strategy is not None else None,
+               check_nan_inf)
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._build(program, block, feed_sig, fetch_names,
-                                   donate_state)
+                                   donate_state, check_nan_inf)
             self._cache[key] = compiled
         fn, read_names, written_names, needs_rng = compiled
 
@@ -244,18 +267,17 @@ class Executor:
             state_ro = {n: self.strategy.shard_state(n, a)
                         for n, a in state_ro.items()}
 
-        new_state, fetches = fn(state_rw, state_ro, feed_arrays)
+        new_state, fetches, guards = fn(state_rw, state_ro, feed_arrays)
         for n, v in new_state.items():
             scope.set_var(n, v)
         if return_numpy:
             fetches = [np.asarray(v) for v in fetches]
-        from .. import config as _config
-        if _config.get_flag("check_nan_inf"):
-            for name, v in zip(fetch_names, fetches):
-                arr = np.asarray(v)
-                if arr.dtype.kind == "f" and not np.isfinite(arr).all():
-                    raise FloatingPointError(
-                        "NaN/Inf in fetched variable %r" % name)
+        if guards:
+            # Per-op output scan (reference framework/executor.cc:120-128).
+            bad = [k for k, ok in guards.items() if not bool(ok)]
+            if bad:
+                raise FloatingPointError(
+                    "NaN/Inf detected in op outputs: %s" % ", ".join(bad))
         return fetches
 
     def as_jax_function(self, program, feed_templates, fetch_list,
@@ -305,7 +327,8 @@ class Executor:
 
         return fn, (state, feed)
 
-    def _build(self, program, block, feed_sig, fetch_names, donate_state):
+    def _build(self, program, block, feed_sig, fetch_names, donate_state,
+               check_nan_inf=False):
         read, written, needs_rng = _block_io(block)
         if needs_rng:
             written.add(RNG_STATE_VAR)
@@ -324,7 +347,8 @@ class Executor:
             env.update(state_ro)
             env.update(state_rw)
             env.update(feed)
-            trace = _TraceState(needs_vjp)
+            trace = _TraceState(needs_vjp,
+                                nan_guards={} if check_nan_inf else None)
             prev = _parallel.set_current_strategy(strategy)
             try:
                 if precision is not None:
@@ -336,7 +360,7 @@ class Executor:
                 _parallel.set_current_strategy(prev)
             new_state = {n: env[n] for n in written_t if n in env}
             fetches = [_lookup(env, n, None, block) for n in fetch_names]
-            return new_state, fetches
+            return new_state, fetches, trace.nan_guards or {}
 
         jit_kwargs = {}
         if donate_state:

@@ -243,6 +243,9 @@ class Block:
         return "\n".join(lines)
 
 
+_program_uid_counter = [0]
+
+
 class Program:
     """A list of Blocks; block 0 is global (reference framework.py:789)."""
 
@@ -250,6 +253,10 @@ class Program:
         self.blocks = [Block(self, 0)]
         self.current_block_idx = 0
         self._version = 0  # bumped on mutation; part of the executor jit key
+        # Monotonic uid: executor cache keys use this instead of id() so a
+        # GC'd Program's id being reused can never alias a stale compile.
+        _program_uid_counter[0] += 1
+        self._uid = _program_uid_counter[0]
         self.random_seed = None
 
     # -- structure -----------------------------------------------------------

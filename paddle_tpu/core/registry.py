@@ -70,13 +70,14 @@ class ExecContext:
     executor before the call, so compute is a pure function.
     """
 
-    __slots__ = ("op", "_values", "rng_key", "block")
+    __slots__ = ("op", "_values", "rng_key", "block", "trace")
 
-    def __init__(self, op, values, rng_key=None, block=None):
+    def __init__(self, op, values, rng_key=None, block=None, trace=None):
         self.op = op
         self._values = values  # slot -> list of values (None for missing)
         self.rng_key = rng_key
         self.block = block
+        self.trace = trace  # executor _TraceState (None in abstract eval)
 
     def input(self, slot, default=None):
         vals = self._values.get(slot)

@@ -5,13 +5,13 @@ Parity with reference ``python/paddle/v2/fluid/io.py:100-284``
 the legacy per-pass checkpointing (``ParamUtil``; Go pserver checkpoints,
 SURVEY §5.3-5.4). TPU-native: state lives in the Scope as device arrays;
 checkpoints are .npz (one file per program scope) + a JSON meta with the
-var list and a pickled ProgramDesc for inference export. Sharded arrays
+var list; inference export serializes the Program as versioned JSON
+(core/serialization.py — the framework.proto analog). Sharded arrays
 gather to host transparently (np.asarray on a sharded jax.Array).
 """
 
 import json
 import os
-import pickle
 
 import numpy as np
 
@@ -99,13 +99,16 @@ def save_checkpoint(executor, dirname, step, main_program=None, scope=None,
     save_persistables(executor, cdir, main_program, scope=scope)
     with open(os.path.join(dirname, "latest.json"), "w") as f:
         json.dump({"step": step, "dir": cdir}, f)
-    # prune old
-    kept = sorted([d for d in os.listdir(dirname)
-                   if d.startswith("checkpoint_")],
-                  key=lambda d: int(d.split("_")[1]))
-    for d in kept[:-keep_last]:
+    # prune old (skip foreign dirs that don't match checkpoint_<int>;
+    # keep_last<=0 means keep everything)
+    if keep_last > 0:
+        import re
         import shutil
-        shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
+        kept = sorted([d for d in os.listdir(dirname)
+                       if re.fullmatch(r"checkpoint_\d+", d)],
+                      key=lambda d: int(d.split("_")[1]))
+        for d in kept[:-keep_last]:
+            shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
 
 
 def load_checkpoint(executor, dirname, main_program=None, scope=None):
@@ -175,15 +178,19 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         "feed_names": list(feeded_var_names),
         "fetch_names": [v.name for v in target_vars],
     }
-    with open(os.path.join(dirname, "__model__"), "wb") as f:
-        pickle.dump({"program": program, "spec": spec}, f)
+    from .core.serialization import program_to_dict
+    with open(os.path.join(dirname, "__model__"), "w") as f:
+        json.dump({"program": program_to_dict(program), "spec": spec}, f)
 
 
 def load_inference_model(dirname, executor, scope=None):
-    """Returns (program, feed_names, fetch_names)."""
-    with open(os.path.join(dirname, "__model__"), "rb") as f:
-        bundle = pickle.load(f)
-    load_params(executor, dirname,
-                main_program=bundle["program"], scope=scope)
+    """Returns (program, feed_names, fetch_names). The __model__ file is
+    versioned JSON (data only — safe to load from untrusted model dirs,
+    unlike pickle; reference ships a protobuf ProgramDesc the same way)."""
+    with open(os.path.join(dirname, "__model__")) as f:
+        bundle = json.load(f)
+    from .core.serialization import program_from_dict
+    program = program_from_dict(bundle["program"])
+    load_params(executor, dirname, main_program=program, scope=scope)
     spec = bundle["spec"]
-    return bundle["program"], spec["feed_names"], spec["fetch_names"]
+    return program, spec["feed_names"], spec["fetch_names"]

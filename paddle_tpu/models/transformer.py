@@ -9,14 +9,14 @@ from .. import layers
 from ..layers.attention import (transformer_encoder_layer,
                                 positional_encoding)
 
-__all__ = ["transformer_lm"]
+__all__ = ["transformer_lm", "transformer_lm_generate"]
 
 
-def transformer_lm(tokens, labels, vocab_size, d_model=128, num_heads=4,
-                   d_ff=256, num_layers=2, ring_axis=None,
-                   dropout_prob=0.0, is_test=False, length=None):
-    """tokens/labels: [B, T] ids (labels = tokens shifted). Returns
-    (loss, logits)."""
+def _lm_backbone(tokens, vocab_size, d_model, num_heads, d_ff, num_layers,
+                 ring_axis=None, dropout_prob=0.0, is_test=False):
+    """tokens [B,T] -> logits [B,T,V]; parameters named via the shared
+    embedding/encoder param_attrs so train and generate programs share
+    weights through the scope."""
     emb = layers.embedding(tokens, size=[vocab_size, d_model],
                            param_attr="tok_embedding")
     x = positional_encoding(emb)
@@ -26,8 +26,17 @@ def transformer_lm(tokens, labels, vocab_size, d_model=128, num_heads=4,
             ring_axis=ring_axis, dropout_prob=dropout_prob,
             is_test=is_test)
     x = layers.layer_norm(x, begin_norm_axis=2)
-    logits = layers.fc(x, vocab_size, num_flatten_dims=2,
-                       bias_attr=False)
+    return layers.fc(x, vocab_size, num_flatten_dims=2, bias_attr=False)
+
+
+def transformer_lm(tokens, labels, vocab_size, d_model=128, num_heads=4,
+                   d_ff=256, num_layers=2, ring_axis=None,
+                   dropout_prob=0.0, is_test=False, length=None):
+    """tokens/labels: [B, T] ids (labels = tokens shifted). Returns
+    (loss, logits)."""
+    logits = _lm_backbone(tokens, vocab_size, d_model, num_heads, d_ff,
+                          num_layers, ring_axis=ring_axis,
+                          dropout_prob=dropout_prob, is_test=is_test)
     t = tokens.shape[1]
     flat_logits = layers.reshape(logits, [-1, vocab_size])
     flat_labels = layers.reshape(labels, [-1, 1])
@@ -41,3 +50,34 @@ def transformer_lm(tokens, labels, vocab_size, d_model=128, num_heads=4,
     else:
         loss = layers.mean(tok_loss)
     return loss, logits
+
+
+def transformer_lm_generate(batch_anchor, vocab_size, d_model=128,
+                            num_heads=4, d_ff=256, num_layers=2,
+                            max_len=16, beam_size=4, bos_id=0, eos_id=1,
+                            return_all_beams=False):
+    """Beam-search generation from the causal LM via the generic
+    BeamSearchDecoder (reference beam_search_op composability demo: the
+    same decode engine drives GRU NMT and this transformer).
+
+    ``batch_anchor``: any [B, ...] variable sizing the batch (e.g. an
+    int32 dummy [B, 1]). The step re-runs the full backbone over the
+    token history (O(L^2) — the simple exact formulation; a KV-cache
+    variant is a state-layout change, not an API change).
+    Returns (ids, lengths, scores).
+    """
+    bs = layers.BeamSearchDecoder(beam_size=beam_size, max_len=max_len,
+                                  bos_id=bos_id, eos_id=eos_id)
+    with bs.step():
+        bs.token()                       # advances via history
+        anchor = bs.state(batch_anchor)  # sizes the batch; never updated
+        del anchor
+        hist = bs.history()              # [N, max_len] tokens so far
+        pos = bs.position()              # [1] current step index
+        logits_all = _lm_backbone(hist, vocab_size, d_model, num_heads,
+                                  d_ff, num_layers, is_test=True)
+        # take logits at the current position: [N,L,V] -> [L,N,V] -> [N,V]
+        by_time = layers.transpose(logits_all, [1, 0, 2])
+        at_pos = layers.gather(by_time, pos)
+        bs.set_logits(layers.reshape(at_pos, [-1, vocab_size]))
+    return bs(return_all_beams=return_all_beams)

@@ -18,5 +18,6 @@ from . import (  # noqa: F401
     control_flow_ops,
     attention_ops,
     crf_ctc_ops,
+    beam_search_ops,
     misc_ops,
 )

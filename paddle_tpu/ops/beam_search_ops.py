@@ -1,0 +1,231 @@
+"""Generic beam search: per-step top-k op, backtrack decode op, and a
+sub-block driver composable with ANY step function.
+
+Reference parity (SURVEY B.4): ``paddle/operators/beam_search_op.h:27-93``
+— ids/scores per live prefix in, top-``beam_size`` per source out, ended
+beams removed from expansion — and ``beam_search_decode_op.cc`` — walk the
+per-step arrays back into full sentences. Also replaces the engine-level
+``RecurrentGradientMachine::beamSearch``
+(``gserver/gradientmachines/RecurrentGradientMachine.h:307-309``).
+
+TPU-first design: XLA needs static shapes, so "shrinking live beams" is
+realized as FROZEN beams — an ended beam keeps its slot but can only emit
+EOS at log-prob 0, so its cumulative score is unchanged and it never
+spawns new prefixes (the exact semantics of the reference's shrinking LoD,
+on fixed [batch, beam] panes). The whole search is one ``lax.scan`` of
+(top-k over beam*vocab, gather-by-parent); decode is a reverse scan over
+recorded (token, parent) pointers — both fuse into the surrounding XLA
+computation.
+
+Three surfaces:
+* ``beam_search`` op      — ONE step (the reference op contract), for
+  hand-rolled IR loops.
+* ``beam_search_decode``  — backtrack recorded steps into sequences.
+* ``dynamic_beam_search`` — driver running a step SUB-BLOCK (any model:
+  GRU, transformer, ...) under the scan; see layers/beam_search.py.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+NEG_INF = -1e9
+
+
+def beam_step(scores, logp, done, eos_id):
+    """One beam-search expansion (pure function, shared by all surfaces).
+
+    scores: [B, K] cumulative log-probs; logp: [B*K, V] per-token
+    log-probs for this step; done: [B, K] bool.
+    Returns (new_scores [B,K], parent [B,K] int32, token [B,K] int32,
+    new_done [B,K]).
+    """
+    B, K = scores.shape
+    V = logp.shape[-1]
+    eos_only = jnp.full((V,), NEG_INF, logp.dtype).at[eos_id].set(0.0)
+    logp = jnp.where(done.reshape(-1)[:, None], eos_only[None, :], logp)
+    cand = scores.reshape(-1)[:, None] + logp          # [B*K, V]
+    cand = cand.reshape(B, K * V)
+    new_scores, top_idx = jax.lax.top_k(cand, K)       # [B, K]
+    parent = (top_idx // V).astype(jnp.int32)
+    token = (top_idx % V).astype(jnp.int32)
+    parent_done = jnp.take_along_axis(done, parent, axis=1)
+    new_done = parent_done | (token == eos_id)
+    return new_scores, parent, token, new_done
+
+
+def backtrack(step_tokens, step_parents):
+    """Walk per-step (token, parent) arrays back into sequences.
+
+    step_tokens/step_parents: [L, B, K]. Returns seqs [B, K, L]: for the
+    beam ending in slot k at step L-1, its full token path.
+    """
+    L, B, K = step_tokens.shape
+
+    def back(nxt, xs):
+        tok_t, par_t = xs
+        toks = jnp.take_along_axis(tok_t, nxt, axis=1)
+        prev = jnp.take_along_axis(par_t, nxt, axis=1)
+        return prev, toks
+
+    init = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None, :],
+                            (B, K))
+    _, toks_rev = jax.lax.scan(back, init,
+                               (jnp.flip(step_tokens, 0),
+                                jnp.flip(step_parents, 0)))
+    seqs = jnp.flip(toks_rev, 0)                       # [L, B, K]
+    return jnp.transpose(seqs, (1, 2, 0))              # [B, K, L]
+
+
+def _finalize(seqs, scores, eos_id, length_penalty):
+    """Lengths (tokens before first EOS), length-normalize, sort beams
+    best-first. seqs [B,K,L], scores [B,K] -> (seqs, lengths, norm) each
+    beam-sorted."""
+    lengths = jnp.sum(jnp.cumsum(seqs == eos_id, axis=-1) == 0,
+                      axis=-1).astype(jnp.int32)       # [B, K]
+    if length_penalty == "avg":
+        norm = scores / jnp.maximum(lengths.astype(scores.dtype), 1.0)
+    else:
+        norm = scores
+    order = jnp.argsort(-norm, axis=1)                 # [B, K] best first
+    seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
+    lengths = jnp.take_along_axis(lengths, order, axis=1)
+    norm = jnp.take_along_axis(norm, order, axis=1)
+    return seqs, lengths, norm
+
+
+def init_scores(batch, beam_size, dtype=jnp.float32):
+    """[B, K] start scores: only beam 0 live (avoids K duplicate beams)."""
+    row = jnp.where(jnp.arange(beam_size) == 0, 0.0, NEG_INF)
+    return jnp.broadcast_to(row, (batch, beam_size)).astype(dtype)
+
+
+def _beam_search_infer(op, block):
+    """[B,K]-shaped outputs mirror PreScores (abstract eval can't relate
+    the B*K logits batch to the B scores batch when B is dynamic)."""
+    pre = block.var_or_none(op.input("PreScores"))
+    if pre is None or pre.shape is None:
+        return
+    for slot, dtype in (("Scores", "float32"), ("Parent", "int32"),
+                        ("Token", "int32"), ("DoneOut", "bool")):
+        v = block.var_or_none(op.output(slot))
+        if v is not None:
+            v.shape = tuple(pre.shape)
+            v.dtype = np.dtype(dtype)
+
+
+@register_op("beam_search", infer_shape=_beam_search_infer)
+def _beam_search(ctx):
+    """Single step, IR-level (reference beam_search_op contract).
+
+    Inputs: PreScores [B,K], Logits [B*K,V] (log_softmax applied unless
+    attr is_log_prob), Done [B,K] (bool/int). Outputs: Scores, Parent,
+    Token, DoneOut.
+    """
+    scores = ctx.input("PreScores")
+    logits = ctx.input("Logits")
+    done = ctx.input("Done").astype(jnp.bool_)
+    if not ctx.attr("is_log_prob", False):
+        logits = jax.nn.log_softmax(logits, axis=-1)
+    new_scores, parent, token, new_done = beam_step(
+        scores, logits, done, ctx.attr("eos_id", 1))
+    return {"Scores": new_scores, "Parent": parent, "Token": token,
+            "DoneOut": new_done}
+
+
+@register_op("beam_search_decode")
+def _beam_search_decode(ctx):
+    """Backtrack per-step arrays into ranked sequences (reference
+    beam_search_decode_op). Inputs: StepTokens [L,B,K], StepParents
+    [L,B,K], FinalScores [B,K]. Outputs: Ids [B,K,L] (EOS-padded),
+    Length [B,K], Scores [B,K] — beams sorted best-first."""
+    seqs = backtrack(ctx.input("StepTokens"), ctx.input("StepParents"))
+    seqs, lengths, norm = _finalize(
+        seqs, ctx.input("FinalScores"), ctx.attr("eos_id", 1),
+        ctx.attr("length_penalty", "avg"))
+    return {"Ids": seqs, "Length": lengths, "Scores": norm}
+
+
+@register_op("dynamic_beam_search", skip_eval_shape=True)
+def _dynamic_beam_search(ctx):
+    """Beam search over a step SUB-BLOCK (any decoder).
+
+    The sub-block maps (token [N] int32, optional position [1] int32,
+    optional history [N, max_len] int32, states...) -> (logits [N, V],
+    updated states...), where N = batch * beam_size. The op tiles initial
+    states per beam, runs the scan with top-k pruning + parent-gather of
+    every state, and backtrack-decodes. States the sub-block never updates
+    are carried unchanged (e.g. encoder outputs — tiled once).
+    """
+    from .control_flow_ops import _run_sub_block
+    program = ctx.block.program
+    sub = program.blocks[ctx.attr("sub_block")]
+    token_var = ctx.attr("token_var")
+    pos_var = ctx.attr("pos_var")          # may be None
+    hist_var = ctx.attr("hist_var")        # may be None
+    logits_var = ctx.attr("logits_var")
+    state_vars = ctx.attr("state_vars")    # [(prev, upd-or-prev)]
+    cap_names = ctx.attr("captured_vars")
+    K = ctx.attr("beam_size", 4)
+    L = ctx.attr("max_len", 32)
+    bos = ctx.attr("bos_id", 0)
+    eos = ctx.attr("eos_id", 1)
+    length_penalty = ctx.attr("length_penalty", "avg")
+
+    captured = dict(zip(cap_names, ctx.inputs("Captured")))
+    init_states = ctx.inputs("InitStates")
+    B = init_states[0].shape[0]
+    # Never-updated states (encoder outputs etc.) are identical across
+    # the K beams of a source forever — tile once into the closure
+    # instead of parent-gathering them every step.
+    const_env = {}
+    dyn_vars, dyn_init = [], []
+    for (prev, upd), s in zip(state_vars, init_states):
+        tiled_s = jnp.repeat(s, K, axis=0)
+        if prev == upd:
+            const_env[prev] = tiled_s
+        else:
+            dyn_vars.append((prev, upd))
+            dyn_init.append(tiled_s)
+    tiled = tuple(dyn_init)
+
+    tok0 = jnp.full((B * K,), bos, jnp.int32)
+    scores0 = init_scores(B, K)
+    done0 = jnp.zeros((B, K), dtype=bool)
+    hist0 = None
+    if hist_var:
+        hist0 = jnp.full((B * K, L), eos, jnp.int32).at[:, 0].set(bos)
+
+    def step(carry, t):
+        states, tok, scores, done, hist = carry
+        env = dict(captured)
+        env.update(const_env)
+        env[token_var] = tok
+        if pos_var:
+            env[pos_var] = jnp.reshape(t, (1,)).astype(jnp.int32)
+        if hist_var:
+            env[hist_var] = hist
+        env.update({prev: s for (prev, _), s in zip(dyn_vars, states)})
+        _run_sub_block(sub, env)
+        logp = jax.nn.log_softmax(env[logits_var], axis=-1)
+        new_scores, parent, token, new_done = beam_step(scores, logp,
+                                                        done, eos)
+        flat_src = (jnp.arange(B, dtype=jnp.int32)[:, None] * K
+                    + parent).reshape(-1)
+        new_states = tuple(env[upd][flat_src] for _, upd in dyn_vars)
+        tok_next = token.reshape(-1)
+        new_hist = None
+        if hist_var:
+            # out-of-bounds column at the last step is dropped by .at
+            new_hist = hist[flat_src].at[:, t + 1].set(tok_next)
+        return (new_states, tok_next, new_scores, new_done, new_hist), \
+            (token, parent)
+
+    (_, _, scores, _, _), (step_toks, step_pars) = jax.lax.scan(
+        step, (tiled, tok0, scores0, done0, hist0), jnp.arange(L))
+    seqs = backtrack(step_toks, step_pars)             # [B, K, L]
+    seqs, lengths, norm = _finalize(seqs, scores, eos, length_penalty)
+    return {"Ids": seqs, "Length": lengths, "Scores": norm}

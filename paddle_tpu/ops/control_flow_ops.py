@@ -21,10 +21,19 @@ import jax.numpy as jnp
 from ..core.registry import register_op
 
 
-def _run_sub_block(block, env):
+def _run_sub_block(block, env, collect_guards=False):
+    """Trace ``block`` against ``env``. With collect_guards, returns a
+    dict of per-op finiteness predicates (for FLAGS_check_nan_inf
+    propagation into sub-blocks — see static_rnn below)."""
     from ..core.executor import run_block, _TraceState
-    run_block(block, env, _TraceState(set()))
-    return env
+    trace = _TraceState(set(),
+                        nan_guards={} if collect_guards else None)
+    run_block(block, env, trace)
+    return trace.nan_guards
+
+
+def _wants_guards(ctx):
+    return ctx.trace is not None and ctx.trace.nan_guards is not None
 
 
 def _rnn_infer_shape(op, block):
@@ -69,17 +78,26 @@ def _static_rnn(ctx):
     init = tuple(ctx.inputs("InitStates"))
     is_reverse = ctx.attr("is_reverse", False)
 
+    want_guards = _wants_guards(ctx)
+
     def body(carry, x_ts):
         env = dict(captured)
         env.update({pv: c for (pv, _), c in zip(state_vars, carry)})
         env.update(dict(zip(step_in_names, x_ts)))
-        _run_sub_block(sub, env)
+        guards = _run_sub_block(sub, env, collect_guards=want_guards)
         new_carry = tuple(env[upd] for _, upd in state_vars)
         outs = tuple(env[n] for n in out_names)
-        return new_carry, outs
+        return new_carry, (outs, guards or {})
 
-    final, outs = jax.lax.scan(body, init, tuple(xs),
-                               reverse=bool(is_reverse))
+    final, (outs, guards_t) = jax.lax.scan(body, init, tuple(xs),
+                                           reverse=bool(is_reverse))
+    if want_guards:
+        # per-op predicates stacked over time -> one bool per sub-op, so
+        # check_nan_inf sees inside the loop (a NaN in a masked step
+        # would otherwise vanish from the final outputs)
+        for key, per_t in guards_t.items():
+            ctx.trace.nan_guards["sub%d/%s" % (sub.idx, key)] = \
+                per_t.all()
     return {"Outputs": [jnp.swapaxes(o, 0, 1) for o in outs],
             "FinalStates": list(final)}
 

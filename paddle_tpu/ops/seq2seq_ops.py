@@ -37,7 +37,7 @@ def _gru_cell(x_and_ctx, hp, w_in, w_h, bias):
     g = gates_x[:, :2 * h] + hp @ w_h[:, :2 * h]
     u, r = jnp.split(jax.nn.sigmoid(g), 2, axis=-1)
     c = jnp.tanh(gates_x[:, 2 * h:] + (r * hp) @ w_h[:, 2 * h:])
-    return u * hp + (1.0 - u) * c
+    return (1.0 - u) * hp + u * c
 
 
 @register_op("attention_gru_decoder")
@@ -118,11 +118,13 @@ def _attention_gru_greedy_decode(ctx):
 
 @register_op("attention_gru_beam_decode")
 def _attention_gru_beam_decode(ctx):
-    """Beam-search generation (reference beam_search_op semantics, SURVEY
-    B.4, done TPU-style): fixed beam width K, batch×beam flattened into the
-    batch dim, length-normalized log-prob scoring, EOS beams frozen.
-    Outputs best sequence per source: Ids [B, max_len], Length [B],
-    Scores [B]."""
+    """Beam-search generation for the fused attention-GRU decoder, built
+    ON the generic beam core (ops/beam_search_ops.py: beam_step per-step
+    top-k with frozen-EOS semantics, backtrack decode — reference
+    beam_search_op/beam_search_decode_op, SURVEY B.4). Outputs best
+    sequence per source: Ids [B, max_len], Length [B], Scores [B]."""
+    from .beam_search_ops import (beam_step, backtrack, _finalize,
+                                  init_scores)
     enc = ctx.input("EncOut")          # [B,T,H]
     mask = ctx.input("EncMask").astype(enc.dtype)
     h0 = ctx.input("H0")               # [B,H]
@@ -136,22 +138,18 @@ def _attention_gru_beam_decode(ctx):
     beam = ctx.attr("beam_size", 4)
     bos = ctx.attr("bos_id", 0)
     eos = ctx.attr("eos_id", 1)
-    B, T, H = enc.shape
-    V = w_out.shape[1]
-    NEG = jnp.asarray(-1e9, enc.dtype)
+    B = enc.shape[0]
 
     # tile encoder state per beam: [B*K, ...]
     enc_t = jnp.repeat(enc, beam, axis=0)
     mask_t = jnp.repeat(mask, beam, axis=0)
     h = jnp.repeat(h0, beam, axis=0)
     tok = jnp.full((B * beam,), bos, jnp.int32)
-    # only beam 0 live initially (avoid duplicate beams)
-    scores = jnp.tile(jnp.where(jnp.arange(beam) == 0, 0.0, NEG), B)
-    done = jnp.zeros((B * beam,), dtype=bool)
-    ids_buf = jnp.full((B * beam, max_len), eos, jnp.int32)
+    scores = init_scores(B, beam, enc.dtype)
+    done = jnp.zeros((B, beam), dtype=bool)
 
     def step(carry, t):
-        h, tok, scores, done, ids_buf = carry
+        h, tok, scores, done = carry
         x_t = emb[tok]
         c = _attend(h, enc_t, enc_t, mask_t, w_att)
         h_new = _gru_cell(jnp.concatenate([x_t, c], axis=-1), h, w_in,
@@ -160,33 +158,15 @@ def _attention_gru_beam_decode(ctx):
         if b_out is not None:
             logit = logit + b_out.reshape(-1)
         logp = jax.nn.log_softmax(logit, axis=-1)          # [B*K, V]
-        # finished beams: only allow EOS with prob 0 (stay frozen)
-        eos_only = jnp.full((V,), NEG).at[eos].set(0.0)
-        logp = jnp.where(done[:, None], eos_only[None, :], logp)
-        cand = scores[:, None] + logp                      # [B*K, V]
-        cand = cand.reshape(B, beam * V)
-        top_scores, top_idx = jax.lax.top_k(cand, beam)    # [B, K]
-        src_beam = top_idx // V                            # [B, K]
-        next_tok = (top_idx % V).astype(jnp.int32)
-        flat_src = (jnp.arange(B)[:, None] * beam + src_beam).reshape(-1)
-        h_next = h_new[flat_src]
-        ids_next = ids_buf[flat_src]
-        done_next = done[flat_src]
-        tok_next = next_tok.reshape(-1)
-        ids_next = ids_next.at[:, t].set(
-            jnp.where(done_next, eos, tok_next))
-        done_next = done_next | (tok_next == eos)
-        return (h_next, tok_next, top_scores.reshape(-1), done_next,
-                ids_next), None
+        new_scores, parent, token, new_done = beam_step(scores, logp,
+                                                        done, eos)
+        flat_src = (jnp.arange(B)[:, None] * beam + parent).reshape(-1)
+        return (h_new[flat_src], token.reshape(-1), new_scores,
+                new_done), (token, parent)
 
-    (h, tok, scores, done, ids_buf), _ = jax.lax.scan(
-        step, (h, tok, scores, done, ids_buf), jnp.arange(max_len))
-    # length-normalized best beam per source
-    lengths = jnp.sum((ids_buf != eos).astype(jnp.int32), axis=1)
-    norm = scores / jnp.maximum(lengths.astype(scores.dtype), 1.0)
-    norm_b = norm.reshape(B, beam)
-    best = jnp.argmax(norm_b, axis=1)
-    flat_best = jnp.arange(B) * beam + best
-    return {"Ids": ids_buf[flat_best],
-            "Length": lengths[flat_best],
-            "Scores": norm_b[jnp.arange(B), best]}
+    (h, tok, scores, done), (step_toks, step_pars) = jax.lax.scan(
+        step, (h, tok, scores, done), jnp.arange(max_len))
+    seqs = backtrack(step_toks, step_pars)                 # [B, K, L]
+    seqs, lengths, norm = _finalize(seqs, scores, eos, "avg")
+    return {"Ids": seqs[:, 0], "Length": lengths[:, 0],
+            "Scores": norm[:, 0]}

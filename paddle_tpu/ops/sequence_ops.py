@@ -9,9 +9,13 @@ loops are ``lax.scan``, which XLA compiles to a single fused TPU while-loop
 (state flows through padded steps unchanged — same effect as the reference's
 shrinking-batch reordering, without the reorder).
 
-Gate layouts (documented for checkpoint conversion): LSTM gates are ordered
-[input, forget, candidate, output]; GRU is [update, reset | candidate],
-h_t = u*h_{t-1} + (1-u)*c_t.
+Gate layouts follow the reference exactly so checkpoints port unchanged:
+dynamic_lstm weight is {W_ch, W_ih, W_fh, W_oh} i.e. gates ordered
+[candidate, input, forget, output] (``lstm_op.cc:125``), bias
+{b_c, b_i, b_f, b_o} (+ peephole {W_ic, W_fc, W_oc}); lstm_unit is
+[input, forget, output, candidate] (``lstm_unit_op.h:63-66``); GRU is
+[update, reset | candidate] with h_t = (1-u)*h_{t-1} + u*c_t
+(``math/detail/gru_kernel.h:61-63``).
 """
 
 import numpy as np
@@ -175,7 +179,7 @@ def _run_lstm(x_proj, w, bias, mask, h0, c0, use_peepholes, acts):
         hp, cp = carry
         x_t, m = inp
         gates = x_t + hp @ w + gate_bias
-        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        gc, gi, gf, go = jnp.split(gates, 4, axis=-1)
         if peep is not None:
             w_ic, w_fc, w_oc = jnp.split(peep, 3)
             gi = gi + cp * w_ic
@@ -246,7 +250,7 @@ def _dynamic_gru(ctx):
         g = x_t[:, :2 * h] + hp @ w_g + bvec[:2 * h]
         u, r = jnp.split(act_gate(g), 2, axis=-1)
         c = act_cand(x_t[:, 2 * h:] + (r * hp) @ w_c + bvec[2 * h:])
-        h_new = u * hp + (1.0 - u) * c
+        h_new = (1.0 - u) * hp + u * c
         h_new = m * h_new + (1.0 - m) * hp
         return h_new, h_new
 
@@ -273,7 +277,7 @@ def _gru_unit(ctx):
     u, r = jnp.split(gate, 2, axis=-1)
     reset_h = r * hp
     c = act_cand(xb[:, 2 * h:] + reset_h @ w[:, 2 * h:])
-    h_new = u * hp + (1.0 - u) * c
+    h_new = (1.0 - u) * hp + u * c
     return {"Hidden": h_new, "Gate": jnp.concatenate([gate, c], axis=-1),
             "ResetHiddenPrev": reset_h}
 
@@ -283,7 +287,7 @@ def _lstm_unit(ctx):
     x = ctx.input("X")  # [b, 4h] pre-projected (from fc over [x, h])
     cp = ctx.input("C_prev")
     forget_bias = ctx.attr("forget_bias", 0.0)
-    gi, gf, gc, go = jnp.split(x, 4, axis=-1)
+    gi, gf, go, gc = jnp.split(x, 4, axis=-1)
     i = jax.nn.sigmoid(gi)
     f = jax.nn.sigmoid(gf + forget_bias)
     c_new = f * cp + i * jnp.tanh(gc)

@@ -66,11 +66,16 @@ class DistStrategy:
     (0th) dim.
     """
 
+    _uid_counter = [0]
+
     def __init__(self, mesh, data_axis="data", param_rules=None):
         self.mesh = mesh
         self.data_axis = data_axis if data_axis in mesh.axis_names else None
         self.param_rules = [(re.compile(pat), spec)
                             for pat, spec in (param_rules or [])]
+        # Monotonic uid for executor cache keys (id() can be reused post-GC).
+        DistStrategy._uid_counter[0] += 1
+        self._uid = DistStrategy._uid_counter[0]
 
     def _named(self, spec):
         return NamedSharding(self.mesh, spec)

@@ -1,0 +1,250 @@
+"""Generic beam search: step-op contract, numpy-golden decode (the analog
+of the reference's test_recurrent_machine_generation golden test),
+composability with GRU and transformer steps."""
+
+import numpy as np
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+
+RS = np.random.RandomState(7)
+NEG = -1e9
+
+
+def _log_softmax(x):
+    x = x - x.max(axis=-1, keepdims=True)
+    return x - np.log(np.exp(x).sum(axis=-1, keepdims=True))
+
+
+def np_beam_search(logits_fn, B, K, L, V, bos, eos):
+    """Trusted straight-line numpy beam search mirroring the B.4 contract
+    (frozen-EOS static-shape formulation). logits_fn(b, tok) -> [V]."""
+    scores = np.where(np.arange(K) == 0, 0.0, NEG)[None, :].repeat(B, 0)
+    done = np.zeros((B, K), dtype=bool)
+    toks = np.full((B, K), bos, dtype=np.int64)
+    paths = [[[] for _ in range(K)] for _ in range(B)]
+    for t in range(L):
+        new_scores = np.empty((B, K))
+        new_done = np.empty((B, K), dtype=bool)
+        new_toks = np.empty((B, K), dtype=np.int64)
+        new_paths = [[None] * K for _ in range(B)]
+        for b in range(B):
+            cand = np.empty((K, V))
+            for k in range(K):
+                if done[b, k]:
+                    row = np.full(V, NEG)
+                    row[eos] = 0.0
+                else:
+                    row = _log_softmax(logits_fn(b, toks[b, k]))
+                cand[k] = scores[b, k] + row
+            flat = cand.reshape(-1)
+            top = np.argsort(-flat, kind="stable")[:K]
+            for j, idx in enumerate(top):
+                k_src, v = divmod(idx, V)
+                new_scores[b, j] = flat[idx]
+                new_toks[b, j] = v
+                new_done[b, j] = done[b, k_src] or v == eos
+                new_paths[b][j] = paths[b][k_src] + [v]
+        scores, done, toks, paths = new_scores, new_done, new_toks, \
+            new_paths
+    ids = np.full((B, K, L), eos, dtype=np.int64)
+    lengths = np.zeros((B, K), dtype=np.int64)
+    for b in range(B):
+        for k in range(K):
+            seq = paths[b][k]
+            ids[b, k, :len(seq)] = seq
+            n = 0
+            while n < len(seq) and seq[n] != eos:
+                n += 1
+            lengths[b, k] = n
+    norm = scores / np.maximum(lengths, 1)
+    order = np.argsort(-norm, axis=1, kind="stable")
+    ids = np.take_along_axis(ids, order[:, :, None], axis=1)
+    lengths = np.take_along_axis(lengths, order, axis=1)
+    norm = np.take_along_axis(norm, order, axis=1)
+    return ids, lengths, norm
+
+
+class TestBeamStepOp:
+    def test_step_contract(self):
+        """Hand-computed expansion: top-k over beam*vocab per source,
+        ended beams frozen (reference beam_search_op.h:27-93)."""
+        B, K, V = 1, 2, 4
+        pre = np.array([[0.0, -1.0]], dtype="float32")
+        # beam 0 favors token 2; beam 1 favors token 0
+        logp = np.log(np.array([[.1, .1, .7, .1],
+                                [.6, .2, .1, .1]], dtype="float32"))
+        done = np.zeros((B, K), dtype=bool)
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            p = layers.data("p", shape=[K])
+            lg = layers.data("lg", shape=[V])
+            d = layers.data("d", shape=[K], dtype="bool")
+            s, par, tok, dout = layers.beam_search_step(
+                p, lg, d, eos_id=3, is_log_prob=True)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        sv, pv, tv, dv = exe.run(main, feed={"p": pre, "lg": logp,
+                                             "d": done},
+                                 fetch_list=[s, par, tok, dout])
+        # best two: beam0+tok2 (0+log.7), then compare beam0+tok0/1/3
+        # (0+log.1=-2.30) vs beam1+tok0 (-1+log.6=-1.51) -> beam1 tok0
+        np.testing.assert_array_equal(pv[0], [0, 1])
+        np.testing.assert_array_equal(tv[0], [2, 0])
+        np.testing.assert_allclose(
+            sv[0], [np.log(.7), -1 + np.log(.6)], rtol=1e-5)
+        assert not dv.any()
+
+    def test_decode_backtrack(self):
+        """Known parent pointers reconstruct the right paths."""
+        # L=3, B=1, K=2
+        toks = np.array([[[5, 6]], [[7, 8]], [[9, 9]]], dtype="int32")
+        pars = np.array([[[0, 0]], [[0, 1]], [[1, 0]]], dtype="int32")
+        scores = np.array([[-1.0, -2.0]], dtype="float32")
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            st = layers.data("st", shape=[1, 2], dtype="int32")
+            sp = layers.data("sp", shape=[1, 2], dtype="int32")
+            fs = layers.data("fs", shape=[2])
+            ids, length, sc = layers.beam_search_decode(
+                st, sp, fs, eos_id=1, length_penalty="none")
+        exe = ptpu.Executor()
+        exe.run(startup)
+        iv, lv, scv = exe.run(
+            main, feed={"st": toks, "sp": pars, "fs": scores},
+            fetch_list=[ids, length, sc])
+        # slot0 at t2: parent 1 -> t1 tok 8 (parent 1) -> t0 tok 6
+        np.testing.assert_array_equal(iv[0, 0], [6, 8, 9])
+        # slot1 at t2: parent 0 -> t1 tok 7 (parent 0) -> t0 tok 5
+        np.testing.assert_array_equal(iv[0, 1], [5, 7, 9])
+
+
+class TestDynamicBeamSearch:
+    def test_golden_vs_numpy(self):
+        """dynamic_beam_search over a sub-block == trusted numpy beam
+        search, for a batch-dependent model (golden-decode test)."""
+        B, K, L, V = 3, 3, 5, 6
+        M = (RS.randn(V, V) * 2).astype("float32")
+        H = (RS.randn(B, V) * 2).astype("float32")
+
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            h0 = layers.data("h0", shape=[V])
+            bs = layers.BeamSearchDecoder(beam_size=K, max_len=L,
+                                          bos_id=0, eos_id=1)
+            with bs.step():
+                tok = bs.token()
+                h = bs.state(h0)  # constant per-source bias, tiled
+                emb = layers.embedding(tok, size=[V, V], param_attr="M")
+                bs.set_logits(layers.elementwise_add(emb, h))
+            ids, lengths, scores = bs(return_all_beams=True)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        ptpu.global_scope().set_var("M", M)
+        iv, lv, sv = exe.run(main, feed={"h0": H},
+                             fetch_list=[ids, lengths, scores])
+
+        g_ids, g_len, g_norm = np_beam_search(
+            lambda b, tok: M[tok] + H[b], B, K, L, V, bos=0, eos=1)
+        np.testing.assert_allclose(sv, g_norm, rtol=1e-4, atol=1e-5)
+        np.testing.assert_array_equal(lv, g_len)
+        np.testing.assert_array_equal(iv, g_ids)
+
+    def test_gru_step_composes(self):
+        """The same decoder drives a real GRU step block (embedding +
+        gru_unit + fc) — the composability the fused-only round-1 op
+        lacked."""
+        B, V, E, Hd, K, L = 2, 8, 6, 5, 2, 4
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            h0 = layers.data("h0", shape=[Hd])
+            bs = layers.BeamSearchDecoder(beam_size=K, max_len=L,
+                                          bos_id=0, eos_id=1)
+            with bs.step():
+                tok = bs.token()
+                hp = bs.state(h0)
+                emb = layers.embedding(tok, size=[V, E],
+                                       param_attr="emb")
+                x = layers.fc(emb, 3 * Hd, param_attr="wx",
+                              bias_attr=False)
+                h_new, _, _ = layers.gru_unit(x, hp, Hd,
+                                              param_attr="wh")
+                bs.update_state(hp, h_new)
+                bs.set_logits(layers.fc(h_new, V, param_attr="wo",
+                                        bias_attr=False))
+            ids, lengths, scores = bs()
+        exe = ptpu.Executor()
+        exe.run(startup)
+        h0v = RS.randn(B, Hd).astype("float32")
+        iv, lv, sv = exe.run(main, feed={"h0": h0v},
+                             fetch_list=[ids, lengths, scores])
+        assert iv.shape == (B, L) and lv.shape == (B,)
+        # eos-padding after each sequence's end
+        for b in range(B):
+            assert (iv[b, lv[b]:] == 1).all()
+        # deterministic
+        iv2, = exe.run(main, feed={"h0": h0v}, fetch_list=[ids])
+        np.testing.assert_array_equal(iv, iv2)
+
+
+class TestTransformerBeam:
+    def test_transformer_lm_generate(self):
+        from paddle_tpu.models.transformer import transformer_lm_generate
+        B, V, L, K = 2, 12, 6, 3
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            anchor = layers.data("anchor", shape=[1], dtype="int32")
+            ids, lengths, scores = transformer_lm_generate(
+                anchor, vocab_size=V, d_model=16, num_heads=2, d_ff=32,
+                num_layers=1, max_len=L, beam_size=K, bos_id=0, eos_id=1,
+                return_all_beams=True)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        anchor_v = np.zeros((B, 1), dtype="int32")
+        iv, lv, sv = exe.run(main, feed={"anchor": anchor_v},
+                             fetch_list=[ids, lengths, scores])
+        assert iv.shape == (B, K, L)
+        assert (iv >= 0).all() and (iv < V).all()
+        # beams sorted best-first
+        assert (np.diff(sv, axis=1) <= 1e-6).all()
+        # eos padding beyond each length
+        for b in range(B):
+            for k in range(K):
+                assert (iv[b, k, lv[b, k]:] == 1).all()
+
+
+class TestNMTConsistency:
+    def test_greedy_equals_beam1(self):
+        """Beam width 1 must reproduce the independent greedy decoder on
+        the real NMT model (cross-validation of the beam machinery)."""
+        from paddle_tpu.models.seq2seq import seq2seq_attention
+        B, T, L = 2, 5, 6
+        sv, tv = 11, 9
+        src = RS.randint(2, sv, (B, T)).astype("int64")
+        src_len = np.array([5, 3], dtype="int64")
+
+        outs = {}
+        for mode in ("greedy", "beam"):
+            with ptpu.unique_name.guard():
+                main, startup = ptpu.Program(), ptpu.Program()
+                with ptpu.program_guard(main, startup):
+                    s = layers.data("src", shape=[T], dtype="int64")
+                    sl = layers.data("src_len", shape=[], dtype="int64")
+                    ids, length = seq2seq_attention(
+                        s, sl, None, None, None, src_vocab=sv,
+                        trg_vocab=tv, emb_dim=8, hid_dim=12, mode=mode,
+                        max_gen_len=L, beam_size=1)
+                exe = ptpu.Executor()
+                # fresh scope per mode: identical startup program + fresh
+                # RNG state -> identical random weights in both modes
+                with ptpu.scope_guard(ptpu.Scope()):
+                    exe.run(startup)
+                    outs[mode] = exe.run(
+                        main, feed={"src": src, "src_len": src_len},
+                        fetch_list=[ids, length])
+        g_ids, g_len = outs["greedy"]
+        b_ids, b_len = outs["beam"]
+        np.testing.assert_array_equal(g_len, b_len)
+        for b in range(2):
+            n = g_len[b]
+            np.testing.assert_array_equal(g_ids[b, :n], b_ids[b, :n])

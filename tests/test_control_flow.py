@@ -122,9 +122,10 @@ class TestStaticRNN:
                 cp = rnn.memory(init=c0)
                 gates = layers.elementwise_add(
                     x_t, layers.mul(hp, w2))
-                gi = layers.slice(gates, [1], [0], [h])
-                gf = layers.slice(gates, [1], [h], [2 * h])
-                gc = layers.slice(gates, [1], [2 * h], [3 * h])
+                # reference gate layout {W_ch, W_ih, W_fh, W_oh}
+                gc = layers.slice(gates, [1], [0], [h])
+                gi = layers.slice(gates, [1], [h], [2 * h])
+                gf = layers.slice(gates, [1], [2 * h], [3 * h])
                 go = layers.slice(gates, [1], [3 * h], [4 * h])
                 c_new = layers.elementwise_add(
                     layers.elementwise_mul(layers.sigmoid(gf), cp),

@@ -86,6 +86,100 @@ class TestIO:
             out, = exe.run(prog, feed={"x": xb}, fetch_list=fetches)
         np.testing.assert_allclose(out, ref, rtol=1e-5)
 
+    def test_model_file_is_json(self, tmp_path):
+        """__model__ must be data-only versioned JSON, never pickle
+        (loading untrusted model dirs must not execute code)."""
+        import json
+        main, startup, loss, pred = _mk_model()
+        exe = ptpu.Executor()
+        exe.run(startup)
+        ptpu.io.save_inference_model(str(tmp_path), ["x"], [pred], exe,
+                                     main)
+        with open(os.path.join(str(tmp_path), "__model__")) as f:
+            bundle = json.load(f)  # raises if not valid JSON
+        assert bundle["program"]["format_version"] == 1
+
+    def test_program_json_roundtrip_with_backward(self):
+        """A full train program (vjp_grad ops with fwd_op references)
+        survives serialization and computes the same loss."""
+        from paddle_tpu.core.serialization import (program_to_dict,
+                                                   program_from_dict)
+        main, startup, loss, _ = _mk_model()
+        exe = ptpu.Executor()
+        exe.run(startup)
+        xb = np.random.RandomState(2).randn(8, 4).astype("float32")
+        feed = {"x": xb, "y": xb.sum(1, keepdims=True)}
+        w0 = np.asarray(ptpu.global_scope().find_var("w")).copy()
+        ref, = exe.run(main, feed=feed, fetch_list=[loss])
+
+        prog2 = program_from_dict(program_to_dict(main))
+        with ptpu.scope_guard(ptpu.Scope()):
+            exe2 = ptpu.Executor()
+            exe2.run(startup)
+            ptpu.global_scope().set_var("w", w0)
+            got, = exe2.run(prog2, feed=feed, fetch_list=[loss])
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_per_op_nan_check(self):
+        """check_nan_inf scans EVERY op's outputs, not just fetches
+        (reference framework/executor.cc:120-128)."""
+        import pytest
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[2])
+            bad = layers.log(x)          # NaN for negative inputs
+            out = layers.reduce_sum(layers.elementwise_mul(
+                bad, layers.fill_constant_batch_size_like(
+                    bad, shape=[-1, 2], dtype="float32", value=0.0)))
+        exe = ptpu.Executor()
+        exe.run(startup)
+        xv = np.array([[-1.0, 2.0]], dtype="float32")
+        ptpu.config.set_flags(check_nan_inf=True)
+        try:
+            with pytest.raises(FloatingPointError, match="log"):
+                exe.run(main, feed={"x": xv}, fetch_list=[out])
+            # clean input passes
+            exe.run(main, feed={"x": np.abs(xv)}, fetch_list=[out])
+        finally:
+            ptpu.config.set_flags(check_nan_inf=False)
+
+    def test_nan_check_inside_static_rnn(self):
+        """A NaN produced INSIDE a scan step and masked to zero in the
+        final output is still caught (sub-block guard propagation)."""
+        import pytest
+        from paddle_tpu.layers.control_flow import StaticRNN
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[3, 2])  # [B, T, D]
+            h0 = layers.fill_constant_batch_size_like(
+                x, shape=[-1, 2], dtype="float32", value=1.0)
+            rnn = StaticRNN()
+            with rnn.step():
+                x_t = rnn.step_input(x)
+                h = rnn.memory(init=h0)
+                bad = layers.log(x_t)          # NaN for negative inputs
+                # multiply by 0: NaN*0 = NaN, then add h -> NaN would
+                # propagate; instead select h via where-like multiplex of
+                # constants so output is clean while `bad` holds NaN
+                zero = layers.fill_constant_batch_size_like(
+                    x, shape=[-1, 2], dtype="float32", value=0.0)
+                keep = layers.elementwise_mul(bad, zero)  # NaN * 0 = NaN
+                del keep  # dead value: never reaches the rnn output
+                rnn.update_memory(h, h)
+                rnn.step_output(h)
+            out = layers.reduce_sum(rnn())
+        exe = ptpu.Executor()
+        exe.run(startup)
+        xv = np.array([[[-1.0, 1.0]] * 3], dtype="float32")
+        # clean without the flag (NaN is dead code)
+        exe.run(main, feed={"x": xv}, fetch_list=[out])
+        ptpu.config.set_flags(check_nan_inf=True)
+        try:
+            with pytest.raises(FloatingPointError, match="sub"):
+                exe.run(main, feed={"x": xv}, fetch_list=[out])
+        finally:
+            ptpu.config.set_flags(check_nan_inf=False)
+
 
 class TestReaders:
     def test_decorators(self):

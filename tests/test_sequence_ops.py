@@ -151,7 +151,8 @@ class TestRNN:
         t._build()
         hid, cell = t.run()
         gates = x[0, 0] + bias.ravel()  # h0 = 0
-        gi, gf, gc, go = np.split(gates, 4)
+        # reference layout {W_ch, W_ih, W_fh, W_oh} (lstm_op.cc:125)
+        gc, gi, gf, go = np.split(gates, 4)
         sig = lambda v: 1 / (1 + np.exp(-v))
         c = sig(gf) * 0 + sig(gi) * np.tanh(gc)
         hh = sig(go) * np.tanh(c)
@@ -195,6 +196,7 @@ class TestRNN:
         t._build()
         hh, cc = t.run()
         sig = lambda v: 1 / (1 + np.exp(-v))
-        gi, gf, gc, go = np.split(x, 4, axis=1)
+        # reference layout [i, f, o, g] (lstm_unit_op.h:63-66)
+        gi, gf, go, gc = np.split(x, 4, axis=1)
         c = sig(gf + 0.5) * c_prev + sig(gi) * np.tanh(gc)
         np.testing.assert_allclose(cc, c, rtol=1e-4, atol=1e-5)
