@@ -134,7 +134,10 @@ def append_gradient_clip_ops(params_grads):
     out = []
     for p, g in params_grads:
         clip = getattr(p, "gradient_clip", None) or _global_clip
-        if g is None or clip is None:
+        if g is None or clip is None or \
+                getattr(g, "selected_rows", None) is not None:
+            # sparse (SelectedRows) grads pass through unclipped — the
+            # clip ops expect dense tensors
             out.append((p, g))
         else:
             out.append((p, clip._clip(p, g)))

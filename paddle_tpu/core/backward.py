@@ -96,11 +96,14 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
 
     # grad bookkeeping: var name -> {"contribs": [grad names], "final": name}
     grads = {}
+    _contrib_counts = {}  # survives grads.pop on non-SSA overwrites
 
     def add_contrib(name):
         entry = grads.setdefault(name, {"contribs": [], "final": None})
-        gname = grad_var_name(name) if not entry["contribs"] else \
-            "%s%s@%d" % (name, GRAD_SUFFIX, len(entry["contribs"]))
+        k = _contrib_counts.get(name, 0)
+        _contrib_counts[name] = k + 1
+        gname = grad_var_name(name) if k == 0 else \
+            "%s%s@%d" % (name, GRAD_SUFFIX, k)
         src = block.var(name)
         block.create_var(name=gname, shape=src.shape, dtype=src.dtype,
                          stop_gradient=True)
@@ -124,6 +127,23 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
                 entry["final"] = out
         return entry["final"]
 
+    # Sparse-eligible embedding tables (SelectedRows path, reference
+    # selected_rows.h / SparseRowMatrix.h): a trainable table consumed by
+    # exactly ONE is_sparse lookup_table gets a (rows, values) gradient
+    # instead of a dense [V, D] cotangent. Tables with any other consumer
+    # fall back to the dense vjp path (contributions must sum densely).
+    consumers = {}
+    for op in fwd_ops:
+        for n in set(op.input_names()):
+            consumers[n] = consumers.get(n, 0) + 1
+    sparse_tables = set()
+    for op in fwd_ops:
+        if op.type == "lookup_table" and op.attrs.get("is_sparse"):
+            w = op.input("W")
+            if w in param_names and consumers.get(w, 0) == 1:
+                sparse_tables.add(w)
+    sparse_grads = {}  # table name -> (rows var name, values var name)
+
     # Seed: d loss / d loss = ones.
     seed = add_contrib(loss.name)
     block.append_op("fill_like", inputs={"X": [loss.name]},
@@ -133,6 +153,26 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
     for i in range(len(fwd_ops) - 1, -1, -1):
         op = fwd_ops[i]
         if op.type in NO_GRAD_OP_TYPES or op.type == "vjp_grad":
+            continue
+        if op.type == "lookup_table" and op.input("W") in sparse_tables:
+            g_out = final_grad(op.output("Out"))
+            if g_out is None:
+                continue
+            w = block.var(op.input("W"))
+            rows_n = "%s%s@ROWS" % (w.name, GRAD_SUFFIX)
+            vals_n = "%s%s@VALUES" % (w.name, GRAD_SUFFIX)
+            block.create_var(name=rows_n, dtype="int32",
+                             stop_gradient=True)
+            block.create_var(name=vals_n, dtype=w.dtype,
+                             stop_gradient=True)
+            block.append_op(
+                "lookup_table_sparse_grad",
+                inputs={"OutGrad": [g_out], "Ids": [op.input("Ids")]},
+                outputs={"Rows": [rows_n], "Values": [vals_n]},
+                attrs={"vocab_size": int(w.shape[0]),
+                       "padding_idx": op.attrs.get("padding_idx")},
+                infer_shape=False)
+            sparse_grads[w.name] = (rows_n, vals_n)
             continue
         out_slots = registry.flat_output_slots(op)
         in_slots = registry.flat_input_slots(op)
@@ -154,6 +194,12 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
         for n in out_names:
             g = final_grad(n)
             out_grad_names.append(g if g is not None else EMPTY_VAR)
+        # Consume the written vars' grad state BEFORE adding input
+        # contributions: an op that overwrites a var it also reads (the
+        # While carry pattern — non-SSA) must not let its own input
+        # contribution alias the already-consumed output gradient.
+        for n in set(out_names):
+            grads.pop(n, None)
         in_grad_names = []
         for n, ok in zip(in_names, need):
             in_grad_names.append(add_contrib(n) if ok else EMPTY_VAR)
@@ -168,6 +214,12 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
     params_and_grads = []
     for pname in sorted(param_names):
         param = block.var(pname)
+        if pname in sparse_grads:
+            rows_n, vals_n = sparse_grads[pname]
+            gvar = block.var(vals_n)
+            gvar.selected_rows = block.var(rows_n)  # SelectedRows marker
+            params_and_grads.append((param, gvar))
+            continue
         g = final_grad(pname)
         if g is None:
             # Unused parameter: gradient is zeros (reference raises; we keep

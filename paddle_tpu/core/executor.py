@@ -136,6 +136,10 @@ def _execute_vjp_grad(op, env, block, trace):
     for val, gname in zip(outs_flat, grad_names):
         if val is None:
             cots.append(None)
+        elif not jnp.issubdtype(val.dtype, jnp.inexact):
+            # int/bool primal outputs (loop counters, conds, ids) take a
+            # float0 cotangent per jax.vjp's calling convention
+            cots.append(np.zeros(val.shape, dtype=jax.dtypes.float0))
         elif gname == EMPTY_VAR:
             cots.append(jnp.zeros_like(val))
         else:

@@ -174,10 +174,16 @@ class While:
             layers.assign(layers.less_than(i, n), cond)
     """
 
-    def __init__(self, cond, name=None, main_program=None):
+    def __init__(self, cond, name=None, main_program=None,
+                 max_iters=None):
+        """``max_iters``: static iteration bound. When given, the loop
+        lowers to a bounded differentiable scan (finished iterations pass
+        state through), so a While-built RNN trains; when None it lowers
+        to lax.while_loop (data-dependent trip count, forward-only)."""
         self.helper = LayerHelper("while", name=name,
                                   main_program=main_program)
         self.cond = cond
+        self.max_iters = max_iters
         self.program = self.helper.main_program
 
     @contextlib.contextmanager
@@ -206,7 +212,8 @@ class While:
             attrs={"sub_block": self.sub_block.idx,
                    "carried_vars": carried,
                    "captured_vars": captured,
-                   "cond_var": self.cond.name},
+                   "cond_var": self.cond.name,
+                   "max_iters": self.max_iters},
             infer_shape=False)
 
 

@@ -77,8 +77,11 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 def embedding(input, size, is_sparse=False, padding_idx=None,
               param_attr=None, dtype="float32", name=None, **kwargs):
-    """Embedding lookup (reference lookup_table_op; ``is_sparse`` is a
-    no-op hint — sparse grads become XLA scatter-adds)."""
+    """Embedding lookup (reference lookup_table_op). With
+    ``is_sparse=True`` the table's gradient is a SelectedRows-style
+    (rows, values) pair — never a dense [V, D] buffer — and
+    SGD/Momentum/Adagrad/Adam apply row-wise scatter updates
+    (ops/sparse_ops.py; reference selected_rows.h)."""
     helper = LayerHelper("embedding", name=name, **kwargs)
     w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype,
                                 default_initializer=NormalInitializer(
@@ -87,7 +90,8 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
     helper.append_op(type="lookup_table",
                      inputs={"W": [w.name], "Ids": [input.name]},
                      outputs={"Out": [out.name]},
-                     attrs={"padding_idx": padding_idx})
+                     attrs={"padding_idx": padding_idx,
+                            "is_sparse": bool(is_sparse)})
     return out
 
 

@@ -7,7 +7,8 @@ __all__ = ["create_tensor", "cast", "concat", "sums", "assign",
            "fill_constant", "fill_constant_batch_size_like", "ones", "zeros",
            "reshape", "transpose", "split", "expand", "gather", "scatter",
            "pad", "crop", "sequence_reshape_noop", "argmax", "argmin",
-           "stack", "slice", "shape", "increment", "multiplex"]
+           "stack", "slice", "shape", "increment", "multiplex",
+           "array_write", "array_read", "create_array"]
 
 
 def create_tensor(dtype, name=None, persistable=False, **kwargs):
@@ -194,6 +195,36 @@ def increment(x, value=1.0, in_place=True, **kwargs):
     helper.append_op(type="increment", inputs={"X": [x.name]},
                      outputs={"Out": [out.name]}, attrs={"step": value},
                      infer_shape=False)
+    return out
+
+
+def create_array(max_len, elem_shape, dtype="float32", **kwargs):
+    """Preallocated [max_len, ...] buffer standing in for the reference's
+    LoDTensorArray (static shapes under XLA). Use with array_write /
+    array_read inside While loops."""
+    return fill_constant([max_len] + list(elem_shape), dtype, 0.0,
+                         **kwargs)
+
+
+def array_write(x, i, array, **kwargs):
+    """array[i] = x (runtime index i); returns the updated array
+    (reference tensor_array_read_write / fluid layers.array_write)."""
+    helper = LayerHelper("array_write", **kwargs)
+    out = helper.create_tmp_variable(array.dtype)
+    helper.append_op(type="array_write",
+                     inputs={"Array": [array.name], "X": [x.name],
+                             "I": [i.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def array_read(array, i, **kwargs):
+    """Returns array[i] (runtime index; fluid layers.array_read)."""
+    helper = LayerHelper("array_read", **kwargs)
+    out = helper.create_tmp_variable(array.dtype)
+    helper.append_op(type="array_read",
+                     inputs={"Array": [array.name], "I": [i.name]},
+                     outputs={"Out": [out.name]})
     return out
 
 

@@ -6,16 +6,19 @@ axis); gradients become XLA scatter-adds + collectives."""
 
 from .. import layers
 
-__all__ = ["wide_deep"]
+__all__ = ["wide_deep", "vocab_shard_rules"]
 
 
 def wide_deep(sparse_ids, dense_feats, label, vocab_size, num_slots,
-              emb_dim=16, hidden=(64, 32)):
+              emb_dim=16, hidden=(64, 32), is_sparse=True):
     """sparse_ids: [N, num_slots] int (one id per slot);
-    dense_feats: [N, D] float; label: [N, 1] float (click)."""
+    dense_feats: [N, D] float; label: [N, 1] float (click).
+    ``is_sparse`` routes the embedding tables through the SelectedRows
+    gradient path (rows+values, row-wise optimizer scatter)."""
     # deep: shared embedding table over all slots
     emb = layers.embedding(sparse_ids, size=[vocab_size, emb_dim],
-                           param_attr="deep_embedding")
+                           param_attr="deep_embedding",
+                           is_sparse=is_sparse)
     deep = layers.reshape(emb, [-1, num_slots * emb_dim])
     deep = layers.concat([deep, dense_feats], axis=1)
     for i, h in enumerate(hidden):
@@ -24,7 +27,8 @@ def wide_deep(sparse_ids, dense_feats, label, vocab_size, num_slots,
 
     # wide: linear over one-hot ids == a [vocab, 1] embedding sum + dense fc
     wide_emb = layers.embedding(sparse_ids, size=[vocab_size, 1],
-                                param_attr="wide_embedding")
+                                param_attr="wide_embedding",
+                                is_sparse=is_sparse)
     wide_sum = layers.reduce_sum(wide_emb, dim=1)
     wide_dense = layers.fc(dense_feats, 1, bias_attr=False)
     logit = layers.elementwise_add(
@@ -35,7 +39,10 @@ def wide_deep(sparse_ids, dense_feats, label, vocab_size, num_slots,
     return loss, pred, logit
 
 
-VOCAB_SHARD_RULES = [
-    # shard embedding vocab dims over the 'model' mesh axis
-    (r"(deep|wide)_embedding", None),  # filled by caller with P('model',)
-]
+def vocab_shard_rules(axis="model"):
+    """DistStrategy param_rules sharding both embedding tables (and their
+    optimizer accumulators, which inherit the param-name prefix) on the
+    vocab dim — no device ever holds a full table (reference capability:
+    pserver sparse shards, SparseParameterDistribution.cpp)."""
+    from .. import parallel
+    return [(r"(deep|wide)_embedding", parallel.P(axis, None))]

@@ -19,5 +19,6 @@ from . import (  # noqa: F401
     attention_ops,
     crf_ctc_ops,
     beam_search_ops,
+    sparse_ops,
     misc_ops,
 )

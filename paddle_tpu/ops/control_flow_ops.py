@@ -106,27 +106,48 @@ def _static_rnn(ctx):
 def _while(ctx):
     """Run the sub-block until the condition var becomes False. Carried =
     the vars the sub-block writes (+ cond); captured = read-only outer
-    vars. Forward-only (lax.while_loop has no transpose rule — training
-    loops use static_rnn/scan instead, as on any XLA backend)."""
+    vars.
+
+    Two lowerings (the reference while_op re-executes its sub-block with
+    step scopes and MakeBlockBackward differentiates it,
+    ``framework/backward.cc:353``; XLA's while has no transpose rule, so):
+    * max_iters=None -> ``lax.while_loop``: data-dependent trip count,
+      forward-only (generation/decoding).
+    * max_iters=N    -> bounded ``lax.scan`` of N steps where finished
+      iterations pass the carry through unchanged. Fully differentiable —
+      a user-built While RNN trains exactly like static_rnn.
+    """
     program = ctx.block.program
     sub = program.blocks[ctx.attr("sub_block")]
     carried_names = ctx.attr("carried_vars")
     cap_names = ctx.attr("captured_vars")
     cond_name = ctx.attr("cond_var")
+    max_iters = ctx.attr("max_iters")
     captured = dict(zip(cap_names, ctx.inputs("Captured")))
     init = tuple(ctx.inputs("Carried"))
     cond_idx = carried_names.index(cond_name)
 
-    def cond_fn(carry):
-        return jnp.reshape(carry[cond_idx], ()).astype(jnp.bool_)
-
-    def body_fn(carry):
+    def run_body(carry):
         env = dict(captured)
         env.update(dict(zip(carried_names, carry)))
         _run_sub_block(sub, env)
         return tuple(env[n] for n in carried_names)
 
-    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    if max_iters is not None:
+        def scan_body(carry, _):
+            alive = jnp.reshape(carry[cond_idx], ()).astype(jnp.bool_)
+            new = run_body(carry)
+            kept = tuple(jnp.where(alive, n, c)
+                         for n, c in zip(new, carry))
+            return kept, None
+
+        final, _ = jax.lax.scan(scan_body, init, None, length=max_iters)
+        return {"CarriedOut": list(final)}
+
+    def cond_fn(carry):
+        return jnp.reshape(carry[cond_idx], ()).astype(jnp.bool_)
+
+    final = jax.lax.while_loop(cond_fn, run_body, init)
     return {"CarriedOut": list(final)}
 
 

@@ -18,9 +18,26 @@ def _lr(ctx):
     return lr.reshape(()) if hasattr(lr, "reshape") else lr
 
 
+def _sparse_rows(ctx, p):
+    """Optional SelectedRows-style sparse grad: returns merged (rows,
+    grad-values) or None for the dense path. Duplicate ids are summed
+    first (reference selected_rows_functor::MergeAdd) so non-linear
+    updates (adagrad/adam moments) see each row once."""
+    if not ctx.has_input("Rows"):
+        return None
+    from .sparse_ops import merge_duplicate_rows
+    return merge_duplicate_rows(ctx.input("Rows"), ctx.input("Grad"),
+                                p.shape[0])
+
+
 @register_op("sgd")
 def _sgd(ctx):
     p, g = ctx.input("Param"), ctx.input("Grad")
+    sparse = _sparse_rows(ctx, p)
+    if sparse is not None:
+        rows, vals = sparse
+        return {"ParamOut": p.at[rows].add(-_lr(ctx) * vals,
+                                           mode="drop")}
     return {"ParamOut": p - _lr(ctx) * g}
 
 
@@ -29,6 +46,18 @@ def _momentum(ctx):
     p, g, v = ctx.input("Param"), ctx.input("Grad"), ctx.input("Velocity")
     mu = ctx.attr("mu", 0.9)
     lr = _lr(ctx)
+    sparse = _sparse_rows(ctx, p)
+    if sparse is not None:
+        # lazy sparse momentum: only touched rows advance their velocity
+        # (reference SparseMomentumParameterOptimizer capability)
+        rows, vals = sparse
+        v_rows = mu * v[rows] + vals
+        if ctx.attr("use_nesterov", False):
+            upd = (vals + mu * v_rows) * lr
+        else:
+            upd = lr * v_rows
+        return {"ParamOut": p.at[rows].add(-upd, mode="drop"),
+                "VelocityOut": v.at[rows].set(v_rows, mode="drop")}
     v_new = mu * v + g
     if ctx.attr("use_nesterov", False):
         p_new = p - (g + mu * v_new) * lr
@@ -46,9 +75,20 @@ def _adam(ctx):
     b2 = ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
     lr = _lr(ctx)
+    lr_t = lr * jnp.sqrt(1.0 - b2p.reshape(())) / (1.0 - b1p.reshape(()))
+    sparse = _sparse_rows(ctx, p)
+    if sparse is not None:
+        # lazy adam: moments advance only for touched rows
+        rows, vals = sparse
+        m_rows = b1 * m[rows] + (1.0 - b1) * vals
+        v_rows = b2 * v[rows] + (1.0 - b2) * jnp.square(vals)
+        upd = lr_t * m_rows / (jnp.sqrt(v_rows) + eps)
+        return {"ParamOut": p.at[rows].add(-upd, mode="drop"),
+                "Moment1Out": m.at[rows].set(m_rows, mode="drop"),
+                "Moment2Out": v.at[rows].set(v_rows, mode="drop"),
+                "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
     m_new = b1 * m + (1.0 - b1) * g
     v_new = b2 * v + (1.0 - b2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(1.0 - b2p.reshape(())) / (1.0 - b1p.reshape(()))
     p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
     return {"ParamOut": p_new, "Moment1Out": m_new, "Moment2Out": v_new,
             "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
@@ -74,6 +114,13 @@ def _adamax(ctx):
 def _adagrad(ctx):
     p, g, m = ctx.input("Param"), ctx.input("Grad"), ctx.input("Moment")
     eps = ctx.attr("epsilon", 1e-6)
+    sparse = _sparse_rows(ctx, p)
+    if sparse is not None:
+        rows, vals = sparse
+        m_rows = m[rows] + jnp.square(vals)
+        upd = _lr(ctx) * vals / (jnp.sqrt(m_rows) + eps)
+        return {"ParamOut": p.at[rows].add(-upd, mode="drop"),
+                "MomentOut": m.at[rows].set(m_rows, mode="drop")}
     m_new = m + jnp.square(g)
     p_new = p - _lr(ctx) * g / (jnp.sqrt(m_new) + eps)
     return {"ParamOut": p_new, "MomentOut": m_new}

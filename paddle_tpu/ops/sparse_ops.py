@@ -1,0 +1,63 @@
+"""SelectedRows-equivalent sparse gradient path for embedding tables.
+
+Reference parity: ``paddle/framework/selected_rows.h`` (rows + values
+sparse gradient), ``paddle/math/SparseRowMatrix.h:31,206`` (sparse-row
+update working set), ``operators/math/selected_rows_functor`` (merge-add
+of duplicate rows) and the sparse update modes of sgd/adagrad/adam/
+momentum ops. TPU-first realization:
+
+* A sparse gradient is (Rows [nnz] int32, Values [nnz, D]) — static
+  shapes (nnz = number of looked-up ids, duplicates included), never a
+  dense [V, D] cotangent. ``lookup_table_sparse_grad`` produces it
+  directly from the output gradient, so the table-sized buffer is never
+  materialized in HBM.
+* Optimizer ops accept an optional Rows input and apply row-wise updates
+  with XLA scatter; out-of-range rows (padding_idx, merge padding) are
+  DROPPED by scatter mode="drop" — the static-shape analog of
+  SelectedRows' variable row count.
+* Under a vocab-sharded PartitionSpec (DistStrategy param_rules), GSPMD
+  partitions the scatter by rows: each shard applies only its own rows —
+  the analog of the pserver's sparse shard update
+  (``SparseParameterDistribution.cpp``), emitted by the compiler.
+"""
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def merge_duplicate_rows(rows, vals, vocab_size):
+    """Sort-based duplicate-row merge with static shapes (the
+    selected_rows_functor::MergeAdd analog).
+
+    Returns (merged_rows, merged_vals) of the SAME length: the first
+    occurrence slot of each unique row carries the summed value; the
+    remaining slots get row index == vocab_size (out of range, dropped by
+    scatter mode='drop')."""
+    order = jnp.argsort(rows)
+    r = rows[order]
+    v = vals[order]
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), r[1:] != r[:-1]])
+    seg = jnp.cumsum(is_start) - 1
+    # compact: segment k's value-sum AND row id both live at slot k;
+    # slots past the last segment keep row == vocab_size (dropped)
+    merged_vals = jnp.zeros_like(vals).at[seg].add(v)
+    merged_rows = jnp.full_like(r, vocab_size).at[seg].min(r)
+    return merged_rows.astype(jnp.int32), merged_vals
+
+
+@register_op("lookup_table_sparse_grad")
+def _lookup_table_sparse_grad(ctx):
+    """d(lookup_table)/dW as (Rows, Values) instead of a dense scatter
+    into [V, D]. padding_idx rows are pushed out of range (their forward
+    output was zeroed, so their gradient is discarded)."""
+    og = ctx.input("OutGrad")     # [..., D]
+    ids = ctx.input("Ids")
+    vocab = ctx.attr("vocab_size")
+    rows = ids.reshape(-1).astype(jnp.int32)
+    vals = og.reshape(-1, og.shape[-1])
+    pad = ctx.attr("padding_idx")
+    if pad is not None:
+        rows = jnp.where(rows == pad, vocab, rows)
+    return {"Rows": rows, "Values": vals}

@@ -92,6 +92,26 @@ def _scatter(ctx):
     return {"Out": x.at[index.reshape(-1)].set(updates)}
 
 
+@register_op("array_write")
+def _array_write(ctx):
+    """arr[i] = x with a runtime scalar index (reference
+    tensor_array_read_write WriteToArray; the LoDTensorArray is realized
+    as a preallocated [max_len, ...] buffer — XLA needs static shapes)."""
+    arr, x, i = ctx.input("Array"), ctx.input("X"), ctx.input("I")
+    idx = jnp.reshape(i, ()).astype(jnp.int32)
+    return {"Out": jax.lax.dynamic_update_index_in_dim(arr, x.astype(
+        arr.dtype), idx, axis=0)}
+
+
+@register_op("array_read")
+def _array_read(ctx):
+    """x = arr[i] with a runtime scalar index (ReadFromArray)."""
+    arr, i = ctx.input("Array"), ctx.input("I")
+    idx = jnp.reshape(i, ()).astype(jnp.int32)
+    return {"Out": jax.lax.dynamic_index_in_dim(arr, idx, axis=0,
+                                                keepdims=False)}
+
+
 @register_op("top_k")
 def _top_k(ctx):
     x = ctx.input("X")

@@ -108,20 +108,42 @@ class Optimizer:
         for param, grad in params_grads:
             if grad is None:
                 continue
+            self._check_sparse(grad)
             ops.append(self._append_optimize_op(main, startup, param, grad))
         return ops
 
     def _append_optimize_op(self, main, startup, param, grad):
         raise NotImplementedError
 
+    @staticmethod
+    def _grad_inputs(grad):
+        """Grad input slots; sparse (SelectedRows-style) grads add Rows."""
+        ins = {"Grad": [grad.name]}
+        rows = getattr(grad, "selected_rows", None)
+        if rows is not None:
+            ins["Rows"] = [rows.name]
+        return ins
+
+    _SPARSE_CAPABLE = False
+
+    def _check_sparse(self, grad):
+        if getattr(grad, "selected_rows", None) is not None and \
+                not self._SPARSE_CAPABLE:
+            raise NotImplementedError(
+                "%s has no sparse (SelectedRows) update rule — use "
+                "SGD/Momentum/Adagrad/Adam for is_sparse embeddings"
+                % type(self).__name__)
+
 
 class SGD(Optimizer):
+    _SPARSE_CAPABLE = True
+
     def _append_optimize_op(self, main, startup, param, grad):
         lr = self._lr_for_param(main, param)
         return main.global_block().append_op(
             "sgd",
-            inputs={"Param": [param.name], "Grad": [grad.name],
-                    "LearningRate": [lr.name]},
+            inputs=dict(self._grad_inputs(grad), Param=[param.name],
+                        LearningRate=[lr.name]),
             outputs={"ParamOut": [param.name]}, infer_shape=False)
 
 
@@ -132,13 +154,15 @@ class Momentum(Optimizer):
         self._momentum = momentum
         self._use_nesterov = use_nesterov
 
+    _SPARSE_CAPABLE = True
+
     def _append_optimize_op(self, main, startup, param, grad):
         vel = self._add_accumulator("velocity", param, main, startup)
         lr = self._lr_for_param(main, param)
         return main.global_block().append_op(
             "momentum",
-            inputs={"Param": [param.name], "Grad": [grad.name],
-                    "Velocity": [vel.name], "LearningRate": [lr.name]},
+            inputs=dict(self._grad_inputs(grad), Param=[param.name],
+                        Velocity=[vel.name], LearningRate=[lr.name]),
             outputs={"ParamOut": [param.name], "VelocityOut": [vel.name]},
             attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
             infer_shape=False)
@@ -149,18 +173,22 @@ class Adagrad(Optimizer):
         super().__init__(learning_rate, **kwargs)
         self._epsilon = epsilon
 
+    _SPARSE_CAPABLE = True
+
     def _append_optimize_op(self, main, startup, param, grad):
         moment = self._add_accumulator("moment", param, main, startup)
         lr = self._lr_for_param(main, param)
         return main.global_block().append_op(
             "adagrad",
-            inputs={"Param": [param.name], "Grad": [grad.name],
-                    "Moment": [moment.name], "LearningRate": [lr.name]},
+            inputs=dict(self._grad_inputs(grad), Param=[param.name],
+                        Moment=[moment.name], LearningRate=[lr.name]),
             outputs={"ParamOut": [param.name], "MomentOut": [moment.name]},
             attrs={"epsilon": self._epsilon}, infer_shape=False)
 
 
 class Adam(Optimizer):
+    _SPARSE_CAPABLE = True  # lazy adam on touched rows
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate, **kwargs)
@@ -176,10 +204,10 @@ class Adam(Optimizer):
         lr = self._lr_for_param(main, param)
         return main.global_block().append_op(
             "adam",
-            inputs={"Param": [param.name], "Grad": [grad.name],
-                    "Moment1": [m1.name], "Moment2": [m2.name],
-                    "Beta1Pow": [b1p.name], "Beta2Pow": [b2p.name],
-                    "LearningRate": [lr.name]},
+            inputs=dict(self._grad_inputs(grad), Param=[param.name],
+                    Moment1=[m1.name], Moment2=[m2.name],
+                    Beta1Pow=[b1p.name], Beta2Pow=[b2p.name],
+                    LearningRate=[lr.name]),
             outputs={"ParamOut": [param.name], "Moment1Out": [m1.name],
                      "Moment2Out": [m2.name], "Beta1PowOut": [b1p.name],
                      "Beta2PowOut": [b2p.name]},

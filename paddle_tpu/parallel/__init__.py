@@ -88,13 +88,22 @@ class DistStrategy:
             return self.replicated()
         return self._named(P(self.data_axis, *([None] * (ndim - 1))))
 
-    def state_sharding(self, name, ndim):
+    def state_sharding(self, name, ndim, shape=None):
         for pat, spec in self.param_rules:
             if pat.search(name):
                 spec_t = tuple(spec)
                 if len(spec_t) < ndim:
                     spec_t = spec_t + (None,) * (ndim - len(spec_t))
-                return self._named(P(*spec_t[:ndim]))
+                spec_t = spec_t[:ndim]
+                if shape is not None:
+                    # drop axes the dim doesn't divide (e.g. a [1] beta-pow
+                    # accumulator whose name matches an embedding rule)
+                    sizes = dict(zip(self.mesh.axis_names,
+                                     self.mesh.devices.shape))
+                    spec_t = tuple(
+                        a if a is None or shape[d] % sizes.get(a, 1) == 0
+                        else None for d, a in enumerate(spec_t))
+                return self._named(P(*spec_t))
         return self.replicated()
 
     def shard_feed(self, name, array):
@@ -104,7 +113,8 @@ class DistStrategy:
 
     def shard_state(self, name, array):
         return jax.device_put(array,
-                              self.state_sharding(name, np.ndim(array)))
+                              self.state_sharding(name, np.ndim(array),
+                                                  np.shape(array)))
 
 
 from .ring_attention import ring_attention, dense_attention  # noqa: E402

@@ -52,7 +52,11 @@ def append_regularization_ops(params_grads, regularization=None):
     out = []
     for param, grad in params_grads:
         reg = getattr(param, "regularizer", None) or regularization
-        if grad is None or reg is None:
+        if grad is None or reg is None or \
+                getattr(grad, "selected_rows", None) is not None:
+            # sparse (SelectedRows) grads skip weight decay — decay over
+            # the full table would densify the update (reference applies
+            # sparse regularization pserver-side; recorded gap)
             out.append((param, grad))
             continue
         block = grad.block

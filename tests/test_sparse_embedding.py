@@ -1,0 +1,154 @@
+"""Sharded sparse-embedding (SelectedRows-equivalent) path.
+
+Reference capability: selected_rows.h + SparseRowMatrix sparse updates +
+pserver sparse shards (SURVEY §2.3 sparse/large-embedding parallelism).
+Tests: sparse==dense optimizer equivalence (incl. duplicate ids, the
+MergeAdd case), and a ≥1M-row Wide&Deep table sharded over the mesh with
+no device holding the full table."""
+
+import numpy as np
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers, parallel
+
+RS = np.random.RandomState(3)
+
+
+def _embedding_model(vocab, dim, is_sparse, opt_factory):
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        ids = layers.data("ids", shape=[4], dtype="int64")
+        label = layers.data("label", shape=[dim])
+        emb = layers.embedding(ids, size=[vocab, dim],
+                               param_attr="table", is_sparse=is_sparse)
+        pooled = layers.reduce_sum(emb, dim=1)
+        loss = layers.mean(layers.square_error_cost(pooled, label))
+        opt_factory().minimize(loss, startup_program=startup)
+    return main, startup, loss
+
+
+class TestSparseDenseEquivalence:
+    def _run(self, opt_factory, steps=3):
+        vocab, dim = 50, 6
+        table0 = (RS.randn(vocab, dim) * 0.1).astype("float32")
+        # duplicate ids inside a batch exercise MergeAdd semantics
+        ids = RS.randint(0, vocab, (steps, 8, 4)).astype("int64")
+        ids[0, 0] = ids[0, 1]  # guaranteed duplicates
+        labels = RS.randn(steps, 8, dim).astype("float32")
+        results = {}
+        for is_sparse in (False, True):
+            with ptpu.unique_name.guard():
+                main, startup, loss = _embedding_model(
+                    vocab, dim, is_sparse, opt_factory)
+            exe = ptpu.Executor()
+            with ptpu.scope_guard(ptpu.Scope()):
+                exe.run(startup)
+                ptpu.global_scope().set_var("table", table0)
+                for t in range(steps):
+                    exe.run(main, feed={"ids": ids[t],
+                                        "label": labels[t]},
+                            fetch_list=[loss])
+                results[is_sparse] = np.asarray(
+                    ptpu.global_scope().find_var("table")).copy()
+        np.testing.assert_allclose(results[True], results[False],
+                                   rtol=2e-4, atol=1e-6)
+
+    def test_sgd(self):
+        self._run(lambda: ptpu.optimizer.SGD(learning_rate=0.1))
+
+    def test_adagrad(self):
+        self._run(lambda: ptpu.optimizer.Adagrad(learning_rate=0.1))
+
+    def test_adam(self):
+        # dense adam decays moments of untouched rows; lazy sparse adam
+        # doesn't — equivalence holds only when every row is touched or
+        # for a single step
+        self._run(lambda: ptpu.optimizer.Adam(learning_rate=0.05),
+                  steps=1)
+
+    def test_momentum(self):
+        self._run(lambda: ptpu.optimizer.Momentum(learning_rate=0.1,
+                                                  momentum=0.9),
+                  steps=1)
+
+    def test_sparse_grad_never_dense(self):
+        """The program must contain a lookup_table_sparse_grad op and NO
+        dense table-grad accumulation for the sparse table."""
+        with ptpu.unique_name.guard():
+            main, _, _ = _embedding_model(
+                1000, 8, True, lambda: ptpu.optimizer.SGD(0.1))
+        types = [op.type for op in main.global_block().ops]
+        assert "lookup_table_sparse_grad" in types
+        assert not main.global_block().has_var("table@GRAD")
+
+    def test_padding_idx_rows_dropped(self):
+        """padding_idx rows receive no update (their fwd output is 0)."""
+        vocab, dim = 10, 4
+        table0 = np.ones((vocab, dim), dtype="float32")
+        with ptpu.unique_name.guard():
+            main, startup = ptpu.Program(), ptpu.Program()
+            with ptpu.program_guard(main, startup):
+                ids = layers.data("ids", shape=[3], dtype="int64")
+                label = layers.data("label", shape=[dim])
+                emb = layers.embedding(ids, size=[vocab, dim],
+                                       param_attr="table",
+                                       is_sparse=True, padding_idx=0)
+                loss = layers.mean(layers.square_error_cost(
+                    layers.reduce_sum(emb, dim=1), label))
+                ptpu.optimizer.SGD(0.5).minimize(loss,
+                                                 startup_program=startup)
+        exe = ptpu.Executor()
+        with ptpu.scope_guard(ptpu.Scope()):
+            exe.run(startup)
+            ptpu.global_scope().set_var("table", table0)
+            exe.run(main, feed={
+                "ids": np.array([[0, 2, 3]], "int64"),
+                "label": np.zeros((1, dim), "float32")})
+            table = np.asarray(ptpu.global_scope().find_var("table"))
+        np.testing.assert_array_equal(table[0], table0[0])  # pad frozen
+        assert not np.allclose(table[2], table0[2])         # real row moved
+
+
+class TestShardedWideDeep:
+    def test_million_row_table_sharded(self):
+        """Wide&Deep with a 1M-row table on the 8-device mesh: the deep
+        table (and its optimizer state) shards over the 'model' axis —
+        no device holds all rows (SURVEY hard-part 3 / config #5)."""
+        import jax
+        from paddle_tpu.models.wide_deep import wide_deep, \
+            vocab_shard_rules
+        V, slots, ddim = 1_000_000, 4, 8
+        mesh = parallel.make_mesh({"data": 2, "model": 4})
+        strategy = parallel.DistStrategy(
+            mesh, data_axis="data", param_rules=vocab_shard_rules("model"))
+        with ptpu.unique_name.guard():
+            main, startup = ptpu.Program(), ptpu.Program()
+            with ptpu.program_guard(main, startup):
+                ids = layers.data("ids", shape=[slots], dtype="int64")
+                dense = layers.data("dense", shape=[ddim])
+                label = layers.data("label", shape=[1])
+                loss, pred, _ = wide_deep(ids, dense, label, V, slots,
+                                          emb_dim=8, hidden=(16,))
+                ptpu.optimizer.Adagrad(0.1).minimize(
+                    loss, startup_program=startup)
+        exe = ptpu.Executor(strategy=strategy)
+        with ptpu.scope_guard(ptpu.Scope()):
+            exe.run(startup)
+            bs = 8
+            feed = {"ids": RS.randint(0, V, (bs, slots)).astype("int64"),
+                    "dense": RS.randn(bs, ddim).astype("float32"),
+                    "label": RS.randint(0, 2, (bs, 1)).astype("float32")}
+            out, = exe.run(main, feed=feed, fetch_list=[loss])
+            assert np.isfinite(out).all()
+            table = ptpu.global_scope().find_var("deep_embedding")
+            # every shard holds V/4 rows — never the full table
+            shards = table.addressable_shards
+            assert len(shards) == 8
+            for sh in shards:
+                assert sh.data.shape[0] == V // 4
+            # optimizer accumulator inherits the vocab sharding
+            acc_name = [n for n in ptpu.global_scope().var_names()
+                        if n.startswith("deep_embedding_moment")]
+            assert acc_name, "adagrad accumulator missing"
+            acc = ptpu.global_scope().find_var(acc_name[0])
+            assert acc.addressable_shards[0].data.shape[0] == V // 4
