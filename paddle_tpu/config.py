@@ -14,6 +14,15 @@ matmul_precision: precision for dot/conv inside executor traces.
 check_nan_inf: if True, the executor asserts every fetched value is finite
   (reference FLAGS_check_nan_inf per-op scan done once per step here —
   per-op would break XLA fusion).
+
+amp: None or 'bfloat16'. Mixed-precision policy applied by the executor at
+  trace time (white/black-listed op boundaries, executor.py): params stay
+  f32 master copies in the scope; inputs of matmul/conv ops are cast to
+  bf16 (the cast sits inside the op's vjp, so param gradients come back
+  f32 — the standard master-weight recipe); loss ops force f32.
+  Motivation (measured, see PROFILE.md): the f32 ResNet-50 train step
+  moves ~140 GB HBM/step at batch 256 and is bandwidth-bound on a TPU
+  v5e (~819 GB/s); bf16 activations halve that.
 """
 
 import jax
@@ -21,6 +30,7 @@ import jax
 _flags = {
     "matmul_precision": None,
     "check_nan_inf": False,
+    "amp": None,
 }
 
 

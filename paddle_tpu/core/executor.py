@@ -48,12 +48,42 @@ def _lookup(env, name, op, block):
                            % (reader, name)) from None
 
 
+# Mixed-precision op lists (config flag "amp"). WHITE ops are the MXU
+# flop sinks: their float inputs are cast to the amp dtype *inside* the
+# op's vjp-wrapped function, so the cast's transpose restores f32 param
+# cotangents (master weights fall out of autodiff). BLACK ops are
+# numerically sensitive reductions: float inputs are forced to f32.
+# Everything else runs in whichever dtype flows in (XLA fuses the
+# converts into neighbouring HLO).
+AMP_WHITE = frozenset({
+    "conv2d", "conv3d", "conv2d_transpose", "conv3d_transpose",
+    "mul", "matmul", "bilinear_tensor_product",
+})
+AMP_BLACK = frozenset({
+    "cross_entropy", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "square_error_cost",
+    "huber_loss", "nce", "cos_sim", "squared_l2_distance",
+})
+
+
+def _amp_cast(op_type, val, amp_dtype):
+    dt = getattr(val, "dtype", None)
+    if dt is None or not jnp.issubdtype(dt, jnp.floating):
+        return val
+    if op_type in AMP_WHITE and dt == jnp.float32:
+        return val.astype(amp_dtype)
+    if op_type in AMP_BLACK and dt != jnp.float32:
+        return val.astype(jnp.float32)
+    return val
+
+
 class _TraceState:
     """Per-trace mutable state shared across ops in one block execution."""
 
-    def __init__(self, needs_vjp, nan_guards=None):
+    def __init__(self, needs_vjp, nan_guards=None, amp=None):
         self.vjp_cache = {}   # id(fwd_op) -> (vjp_fn, flat_out_values)
         self.needs_vjp = needs_vjp
+        self.amp = jnp.dtype(amp) if amp else None
         # When not None: dict collecting per-op finiteness predicates
         # ("op#i:type:var" -> scalar bool). The reference scans every op's
         # outputs under FLAGS_check_nan_inf (framework/executor.cc:120-128);
@@ -90,6 +120,8 @@ def _execute_forward_op(op, env, block, trace):
     if opdef.needs_rng:
         env[RNG_STATE_VAR], rng_key = jax.random.split(env[RNG_STATE_VAR])
 
+    amp = trace.amp
+
     if id(op) in trace.needs_vjp:
         in_slots = registry.flat_input_slots(op)
         out_slots = registry.flat_output_slots(op)
@@ -98,7 +130,9 @@ def _execute_forward_op(op, env, block, trace):
         def f(*args):
             vals = {slot: list(lst) for slot, lst in values.items()}
             for (slot, i), a in zip(in_slots, args):
-                vals[slot][i] = a
+                # amp cast INSIDE the vjp: its transpose restores f32
+                # cotangents for f32 params (master-weight recipe)
+                vals[slot][i] = _amp_cast(op.type, a, amp) if amp else a
             ctx = registry.ExecContext(op, vals, rng_key=rng_key,
                                        block=block, trace=trace)
             result = registry.normalize_outputs(op, opdef.compute(ctx))
@@ -113,6 +147,9 @@ def _execute_forward_op(op, env, block, trace):
             if i < len(names) and val is not None and names[i] != EMPTY_VAR:
                 env[names[i]] = val
     else:
+        if amp and (op.type in AMP_WHITE or op.type in AMP_BLACK):
+            values = {slot: [_amp_cast(op.type, v, amp) for v in lst]
+                      for slot, lst in values.items()}
         ctx = registry.ExecContext(op, values, rng_key=rng_key,
                                    block=block, trace=trace)
         result = registry.normalize_outputs(op, opdef.compute(ctx))
@@ -231,16 +268,17 @@ class Executor:
 
         from .. import config as _config
         check_nan_inf = bool(_config.get_flag("check_nan_inf"))
+        amp = _config.get_flag("amp")
         feed_sig = tuple(sorted((n, tuple(a.shape), str(a.dtype))
                                 for n, a in feed_arrays.items()))
         key = (program._uid, program._version, feed_sig, tuple(fetch_names),
                bool(donate_state),
                self.strategy._uid if self.strategy is not None else None,
-               check_nan_inf)
+               check_nan_inf, amp)
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._build(program, block, feed_sig, fetch_names,
-                                   donate_state, check_nan_inf)
+                                   donate_state, check_nan_inf, amp)
             self._cache[key] = compiled
         fn, read_names, written_names, needs_rng = compiled
 
@@ -317,11 +355,12 @@ class Executor:
 
         from .. import config as _config
         precision = _config.resolve_matmul_precision()
+        amp = _config.get_flag("amp")
 
         def fn(state, feed):
             env = dict(state)
             env.update(feed)
-            trace = _TraceState(needs_vjp)
+            trace = _TraceState(needs_vjp, amp=amp)
             if precision is not None:
                 with jax.default_matmul_precision(precision):
                     run_block(block, env, trace)
@@ -332,7 +371,7 @@ class Executor:
         return fn, (state, feed)
 
     def _build(self, program, block, feed_sig, fetch_names, donate_state,
-               check_nan_inf=False):
+               check_nan_inf=False, amp=None):
         read, written, needs_rng = _block_io(block)
         if needs_rng:
             written.add(RNG_STATE_VAR)
@@ -352,7 +391,8 @@ class Executor:
             env.update(state_rw)
             env.update(feed)
             trace = _TraceState(needs_vjp,
-                                nan_guards={} if check_nan_inf else None)
+                                nan_guards={} if check_nan_inf else None,
+                                amp=amp)
             prev = _parallel.set_current_strategy(strategy)
             try:
                 if precision is not None:

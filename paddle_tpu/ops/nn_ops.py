@@ -174,17 +174,24 @@ def _batch_norm(ctx):
     shape = [1] * x.ndim
     shape[1 if layout == "NCHW" else x.ndim - 1] = -1
 
+    # Moments always in f32 (bf16 E[x^2] underflows); the normalization is
+    # folded to y = x*a + b with per-channel a,b cast to x.dtype, so under
+    # the amp policy x is read/written once in bf16 (HBM-bandwidth bound
+    # path, see PROFILE.md).
+    xs = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
     if is_test:
         use_mean, use_var = mean, var
         new_mean, new_var = mean, var
     else:
-        use_mean = jnp.mean(x, axis=axes)
-        use_var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(use_mean)
+        use_mean = jnp.mean(xs, axis=axes)
+        use_var = jnp.mean(jnp.square(xs), axis=axes) - jnp.square(use_mean)
         new_mean = momentum * mean + (1.0 - momentum) * use_mean
         new_var = momentum * var + (1.0 - momentum) * use_var
     inv = jax.lax.rsqrt(use_var + eps)
-    y = (x - use_mean.reshape(shape)) * inv.reshape(shape) \
-        * scale.reshape(shape) + bias.reshape(shape)
+    a = inv * scale
+    b = bias - use_mean * a
+    y = x * a.reshape(shape).astype(x.dtype) \
+        + b.reshape(shape).astype(x.dtype)
     return {"Y": y, "MeanOut": new_mean, "VarianceOut": new_var,
             "SavedMean": use_mean, "SavedVariance": inv}
 

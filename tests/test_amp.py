@@ -1,0 +1,92 @@
+"""Mixed precision (config flag amp='bfloat16').
+
+Checks the master-weight recipe the executor implements at trace time
+(core/executor.py AMP_WHITE/AMP_BLACK): params stay f32 in the scope,
+white-listed op inputs are cast to bf16 inside the vjp (so param grads
+come back f32), loss ops compute in f32, and one amp train step stays
+close to the f32 step.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+
+
+def _build(seed=7):
+    main, startup = ptpu.Program(), ptpu.Program()
+    main.random_seed = startup.random_seed = seed
+    with ptpu.program_guard(main, startup):
+        img = layers.data("img", shape=[3, 8, 8])
+        label = layers.data("label", shape=[1], dtype="int64")
+        conv = layers.conv2d(img, num_filters=8, filter_size=3, padding=1,
+                             act=None, bias_attr=False)
+        bn = layers.batch_norm(conv, act="relu")
+        pool = layers.pool2d(bn, pool_size=8, pool_type="avg",
+                             global_pooling=True)
+        flat = layers.reshape(pool, [-1, 8])
+        logits = layers.fc(flat, 10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        opt = ptpu.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        opt.minimize(loss, startup_program=startup)
+    return main, startup, loss
+
+
+def _run_steps(exe, main, startup, loss, amp, snapshot, steps=3):
+    """Restore params from ``snapshot``, then train ``steps`` steps."""
+    scope = ptpu.global_scope()
+    for n, v in snapshot.items():
+        scope.set_var(n, v)
+    ptpu.config.set_flags(amp=amp)
+    try:
+        rs = np.random.RandomState(0)
+        feed = {"img": rs.randn(4, 3, 8, 8).astype("float32"),
+                "label": rs.randint(0, 10, (4, 1)).astype("int64")}
+        losses = []
+        for _ in range(steps):
+            out, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(out))
+        dtypes = {n: np.asarray(scope.find_var(n)).dtype
+                  for n in snapshot}
+        return losses, dtypes
+    finally:
+        ptpu.config.set_flags(amp=None)
+
+
+def test_amp_matches_f32_and_keeps_f32_params():
+    main, startup, loss = _build()
+    exe = ptpu.Executor()
+    exe.run(startup)
+    scope = ptpu.global_scope()
+    snapshot = {n: np.asarray(scope.find_var(n))
+                for n in scope.var_names()}
+    ref_losses, _ = _run_steps(exe, main, startup, loss, None, snapshot)
+    amp_losses, dtypes = _run_steps(exe, main, startup, loss, "bfloat16",
+                                    snapshot)
+    # all persistable state (params, momentum accumulators, BN stats)
+    # remains f32 master copies
+    for name, dt in dtypes.items():
+        if np.issubdtype(dt, np.floating):
+            assert dt == np.float32, (name, dt)
+    # training trajectory tracks the f32 run at bf16 resolution
+    np.testing.assert_allclose(amp_losses, ref_losses, rtol=5e-2, atol=5e-2)
+    # it actually trained
+    assert amp_losses[-1] < amp_losses[0] + 1e-3
+
+
+def test_amp_casts_are_invisible_to_fetches():
+    """Fetched loss is f32 (loss ops black-listed to f32 compute)."""
+    ptpu.config.set_flags(amp="bfloat16")
+    try:
+        main, startup, loss = _build()
+        exe = ptpu.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        feed = {"img": rs.randn(4, 3, 8, 8).astype("float32"),
+                "label": rs.randint(0, 10, (4, 1)).astype("int64")}
+        out, = exe.run(main, feed=feed, fetch_list=[loss],
+                       return_numpy=False)
+        assert out.dtype == np.float32
+    finally:
+        ptpu.config.set_flags(amp=None)
