@@ -4,7 +4,8 @@ Parity with reference ``fluid/layers/control_flow.py`` (StaticRNN, While,
 IfElse, less_than, Print) and the legacy recurrent_group
 (RecurrentGradientMachine, SURVEY B.3). TPU-native lowering lives in
 ops/control_flow_ops.py: StaticRNN -> differentiable lax.scan; While ->
-lax.while_loop (forward-only); cond -> lax.cond.
+differentiable bounded scan with max_iters, else lax.while_loop
+(forward-only); cond -> lax.cond (differentiable).
 """
 
 import contextlib
@@ -161,7 +162,10 @@ class StaticRNN:
 class While:
     """Run a block until ``cond`` becomes False (reference While /
     while_op). The sub-block must update ``cond`` and may only write vars
-    that already exist in the parent (the loop carry). Forward-only.
+    that already exist in the parent (the loop carry). With
+    ``max_iters`` the loop is fully differentiable (bounded scan, the
+    analog of reference MakeBlockBackward ``framework/backward.cc:353``);
+    without it, forward-only (lax.while_loop has no vjp).
 
     Usage::
 
@@ -205,6 +209,24 @@ class While:
         captured = [n for n in _block_external_reads(self.sub_block)
                     if n not in set(carried)
                     and self.parent_block.has_var(n)]
+        if self.max_iters is not None:
+            # The bounded loop lowers to a differentiable scan, so float
+            # carries are live gradient paths even when their defining op
+            # was a constant fill (fill_constant marks its output
+            # stop_gradient=True; as a loop carry it is loop *state*, and
+            # append_backward must route cotangents into the while op).
+            # Only constant-fill outputs are flipped — a user explicitly
+            # freezing a non-constant carry keeps stop_gradient.
+            from ..core.backward import _float_like
+            const_fills = {"fill_constant", "fill_constant_batch_size_like",
+                           "fill_like", "assign_value"}
+            const_outs = {n for op in self.parent_block.ops
+                          if op.type in const_fills
+                          for n in op.output_names()}
+            for n in carried:
+                v = self.parent_block.var(n)
+                if n in const_outs and _float_like(self.parent_block, n):
+                    v.stop_gradient = False
         self.parent_block.append_op(
             type="while",
             inputs={"Carried": carried, "Captured": captured},

@@ -160,7 +160,8 @@ def _dynamic_beam_search(ctx):
     every state, and backtrack-decodes. States the sub-block never updates
     are carried unchanged (e.g. encoder outputs — tiled once).
     """
-    from .control_flow_ops import _run_sub_block
+    from .control_flow_ops import _run_sub_block, _parent_amp
+    amp = _parent_amp(ctx)
     program = ctx.block.program
     sub = program.blocks[ctx.attr("sub_block")]
     token_var = ctx.attr("token_var")
@@ -209,13 +210,18 @@ def _dynamic_beam_search(ctx):
         if hist_var:
             env[hist_var] = hist
         env.update({prev: s for (prev, _), s in zip(dyn_vars, states)})
-        _run_sub_block(sub, env)
+        _run_sub_block(sub, env, amp=amp)
         logp = jax.nn.log_softmax(env[logits_var], axis=-1)
         new_scores, parent, token, new_done = beam_step(scores, logp,
                                                         done, eos)
         flat_src = (jnp.arange(B, dtype=jnp.int32)[:, None] * K
                     + parent).reshape(-1)
-        new_states = tuple(env[upd][flat_src] for _, upd in dyn_vars)
+        # pin carry dtypes (amp casts must not flip the scan carry)
+        new_states = tuple(
+            env[upd][flat_src].astype(s.dtype)
+            if hasattr(s, "dtype") and env[upd].dtype != s.dtype
+            else env[upd][flat_src]
+            for (_, upd), s in zip(dyn_vars, states))
         tok_next = token.reshape(-1)
         new_hist = None
         if hist_var:

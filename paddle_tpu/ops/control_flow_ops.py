@@ -8,8 +8,13 @@ legacy RecurrentGradientMachine, SURVEY B.3), TPU-first:
   whole thing is a pure JAX function, jax.vjp differentiates THROUGH the
   scan — training works with no recurrent_grad machinery (the reference
   needed per-frame cloned sub-networks with scatter/gather agents).
-* while      -> ``lax.while_loop`` (forward-only; generation/decoding).
-* cond       -> ``lax.cond`` over two traced branch blocks.
+* while      -> bounded ``lax.scan`` when max_iters is given (fully
+  differentiable: a user-built While RNN trains, the analog of the
+  reference's MakeBlockBackward, ``framework/backward.cc:353``), else
+  ``lax.while_loop`` (data-dependent trip count; forward-only,
+  generation/decoding).
+* cond       -> ``lax.cond`` over two traced branch blocks
+  (differentiable).
 
 The trip structure must be static-shape (XLA): step inputs are padded
 [batch, time, ...] tensors; while-carried vars keep their shapes.
@@ -21,19 +26,25 @@ import jax.numpy as jnp
 from ..core.registry import register_op
 
 
-def _run_sub_block(block, env, collect_guards=False):
+def _run_sub_block(block, env, collect_guards=False, amp=None):
     """Trace ``block`` against ``env``. With collect_guards, returns a
     dict of per-op finiteness predicates (for FLAGS_check_nan_inf
-    propagation into sub-blocks — see static_rnn below)."""
+    propagation into sub-blocks — see static_rnn below). ``amp``
+    propagates the parent trace's mixed-precision policy."""
     from ..core.executor import run_block, _TraceState
     trace = _TraceState(set(),
-                        nan_guards={} if collect_guards else None)
+                        nan_guards={} if collect_guards else None,
+                        amp=amp)
     run_block(block, env, trace)
     return trace.nan_guards
 
 
 def _wants_guards(ctx):
     return ctx.trace is not None and ctx.trace.nan_guards is not None
+
+
+def _parent_amp(ctx):
+    return ctx.trace.amp if ctx.trace is not None else None
 
 
 def _rnn_infer_shape(op, block):
@@ -80,12 +91,21 @@ def _static_rnn(ctx):
 
     want_guards = _wants_guards(ctx)
 
+    amp = _parent_amp(ctx)
+
     def body(carry, x_ts):
         env = dict(captured)
         env.update({pv: c for (pv, _), c in zip(state_vars, carry)})
         env.update(dict(zip(step_in_names, x_ts)))
-        guards = _run_sub_block(sub, env, collect_guards=want_guards)
-        new_carry = tuple(env[upd] for _, upd in state_vars)
+        guards = _run_sub_block(sub, env, collect_guards=want_guards,
+                                amp=amp)
+        # pin carry dtypes: an amp-cast op feeding a memory update must
+        # not flip the scan carry type (lax.scan requires fixed carries)
+        new_carry = tuple(
+            env[upd].astype(c.dtype)
+            if hasattr(c, "dtype") and env[upd].dtype != c.dtype
+            else env[upd]
+            for (_, upd), c in zip(state_vars, carry))
         outs = tuple(env[n] for n in out_names)
         return new_carry, (outs, guards or {})
 
@@ -127,11 +147,17 @@ def _while(ctx):
     init = tuple(ctx.inputs("Carried"))
     cond_idx = carried_names.index(cond_name)
 
+    amp = _parent_amp(ctx)
+
     def run_body(carry):
         env = dict(captured)
         env.update(dict(zip(carried_names, carry)))
-        _run_sub_block(sub, env)
-        return tuple(env[n] for n in carried_names)
+        _run_sub_block(sub, env, amp=amp)
+        # pin carry dtypes (amp casts must not flip while/scan carries)
+        return tuple(
+            env[n].astype(c.dtype)
+            if hasattr(c, "dtype") and env[n].dtype != c.dtype else env[n]
+            for n, c in zip(carried_names, carry))
 
     if max_iters is not None:
         def scan_body(carry, _):
@@ -163,10 +189,12 @@ def _cond(ctx):
     captured = dict(zip(cap_names, ctx.inputs("Captured")))
     pred = jnp.reshape(ctx.input("Cond"), ()).astype(jnp.bool_)
 
+    amp = _parent_amp(ctx)
+
     def branch(block, out_names):
         def fn(cap):
             env = dict(cap)
-            _run_sub_block(block, env)
+            _run_sub_block(block, env, amp=amp)
             return tuple(env[n] for n in out_names)
         return fn
 

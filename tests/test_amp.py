@@ -90,3 +90,47 @@ def test_amp_casts_are_invisible_to_fetches():
         assert out.dtype == np.float32
     finally:
         ptpu.config.set_flags(amp=None)
+
+
+def test_amp_inside_bounded_while_keeps_carry_dtype():
+    """amp casts inside a loop sub-block must not flip the scan carry
+    dtype (a mul feeding an assign'd carry would otherwise return bf16
+    for an f32 carry and break lax.scan's fixed-carry contract)."""
+    from paddle_tpu.layers.control_flow import While
+    ptpu.config.set_flags(amp="bfloat16")
+    try:
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            w = main.global_block().create_parameter(
+                name="loop_w", shape=[4, 4], dtype="float32",
+                initializer=ptpu.initializer.Constant(0.1))
+            sv = startup.global_block().create_var(
+                name="loop_w", shape=[4, 4], dtype="float32",
+                persistable=True)
+            ptpu.initializer.Constant(0.1)(sv, startup.global_block())
+            i = layers.fill_constant([1], "int32", 0)
+            n = layers.fill_constant([1], "int32", 3)
+            h = layers.fill_constant([2, 4], "float32", 1.0)
+            cond_v = layers.less_than(i, n)
+            wl = While(cond_v, max_iters=3)
+            with wl.block():
+                # carry assigned straight from a WHITE-listed op output
+                layers.assign(layers.mul(h, w), h)
+                i2 = layers.increment(i, 1, in_place=False)
+                layers.assign(i2, i)
+                layers.assign(layers.less_than(i2, n), cond_v)
+            loss = layers.mean(h)
+            ptpu.optimizer.SGD(learning_rate=0.1).minimize(
+                loss, startup_program=startup)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        out, = exe.run(main, feed={"x": np.zeros((2, 4), "float32")},
+                       fetch_list=[loss])
+        assert np.isfinite(out).all()
+        # grads reached the in-loop parameter (it moved from 0.1)
+        wv = np.asarray(ptpu.global_scope().find_var("loop_w"))
+        assert wv.dtype == np.float32
+        assert np.abs(wv - 0.1).max() > 1e-6
+    finally:
+        ptpu.config.set_flags(amp=None)
