@@ -220,9 +220,13 @@ class While:
             from ..core.backward import _float_like
             const_fills = {"fill_constant", "fill_constant_batch_size_like",
                            "fill_like", "assign_value"}
-            const_outs = {n for op in self.parent_block.ops
-                          if op.type in const_fills
-                          for n in op.output_names()}
+            const_outs = set()
+            blk = self.parent_block  # walk the same ancestor chain var()
+            while blk is not None:   # resolves through (nested loops)
+                const_outs.update(n for op in blk.ops
+                                  if op.type in const_fills
+                                  for n in op.output_names())
+                blk = blk.parent
             for n in carried:
                 v = self.parent_block.var(n)
                 if n in const_outs and _float_like(self.parent_block, n):

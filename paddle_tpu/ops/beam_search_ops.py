@@ -216,12 +216,9 @@ def _dynamic_beam_search(ctx):
                                                         done, eos)
         flat_src = (jnp.arange(B, dtype=jnp.int32)[:, None] * K
                     + parent).reshape(-1)
-        # pin carry dtypes (amp casts must not flip the scan carry)
-        new_states = tuple(
-            env[upd][flat_src].astype(s.dtype)
-            if hasattr(s, "dtype") and env[upd].dtype != s.dtype
-            else env[upd][flat_src]
-            for (_, upd), s in zip(dyn_vars, states))
+        from .control_flow_ops import _pin_carry_dtype
+        new_states = tuple(_pin_carry_dtype(env[upd][flat_src], s)
+                           for (_, upd), s in zip(dyn_vars, states))
         tok_next = token.reshape(-1)
         new_hist = None
         if hist_var:

@@ -47,6 +47,14 @@ def _parent_amp(ctx):
     return ctx.trace.amp if ctx.trace is not None else None
 
 
+def _pin_carry_dtype(new, old):
+    """Cast a scan/while carry update back to the carry's dtype — amp
+    casts inside a sub-block must not flip lax's fixed-carry types."""
+    if hasattr(old, "dtype") and new.dtype != old.dtype:
+        return new.astype(old.dtype)
+    return new
+
+
 def _rnn_infer_shape(op, block):
     program = block.program
     sub = program.blocks[op.attrs["sub_block"]]
@@ -99,13 +107,8 @@ def _static_rnn(ctx):
         env.update(dict(zip(step_in_names, x_ts)))
         guards = _run_sub_block(sub, env, collect_guards=want_guards,
                                 amp=amp)
-        # pin carry dtypes: an amp-cast op feeding a memory update must
-        # not flip the scan carry type (lax.scan requires fixed carries)
-        new_carry = tuple(
-            env[upd].astype(c.dtype)
-            if hasattr(c, "dtype") and env[upd].dtype != c.dtype
-            else env[upd]
-            for (_, upd), c in zip(state_vars, carry))
+        new_carry = tuple(_pin_carry_dtype(env[upd], c)
+                          for (_, upd), c in zip(state_vars, carry))
         outs = tuple(env[n] for n in out_names)
         return new_carry, (outs, guards or {})
 
@@ -153,11 +156,8 @@ def _while(ctx):
         env = dict(captured)
         env.update(dict(zip(carried_names, carry)))
         _run_sub_block(sub, env, amp=amp)
-        # pin carry dtypes (amp casts must not flip while/scan carries)
-        return tuple(
-            env[n].astype(c.dtype)
-            if hasattr(c, "dtype") and env[n].dtype != c.dtype else env[n]
-            for n, c in zip(carried_names, carry))
+        return tuple(_pin_carry_dtype(env[n], c)
+                     for n, c in zip(carried_names, carry))
 
     if max_iters is not None:
         def scan_body(carry, _):

@@ -1,0 +1,221 @@
+"""Arena-staged input pipeline: host batch assembly -> device, overlapped.
+
+The TPU-native analog of the reference's async double-buffer DataProvider
+(``paddle/gserver/dataproviders/DataProvider.h:375``) and its pinned
+staging buffers (``paddle/memory/memory.cc`` pinned path): a background
+thread
+
+1. pulls batches from the reader (through an optional ``DataFeeder``),
+2. copies each array into a 64-byte-aligned block of the native buddy
+   arena (``native/buddy_allocator.cc``) — stable host staging memory,
+   the pinned-buffer analog,
+3. dispatches ``jax.device_put`` (async H2D) and queues the ready feed,
+
+so host batch assembly and H2D transfer overlap the device step that the
+consumer is running. Arena blocks are recycled with a two-batch lag: by
+the time batch K+2 is staged, the step consuming batch K has been
+dispatched and device execution is serialized behind its transfer.
+
+Falls back to plain numpy copies (still background-threaded) if the
+native library is unavailable; ``arena_active`` reports which path is in
+use.
+"""
+
+import collections
+import ctypes
+import queue as _queue
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["StagedReader"]
+
+_END = object()
+
+
+class _Arena:
+    """ctypes wrapper over one native buddy arena."""
+
+    def __init__(self, capacity_bytes):
+        from .. import native
+        self._lib = native.arena_lib()
+        self._handle = self._lib.ptarena_create(
+            ctypes.c_size_t(capacity_bytes))
+        if not self._handle:
+            raise MemoryError("buddy arena creation failed")
+
+    def alloc_array(self, shape, dtype, nbytes):
+        ptr = self._lib.ptarena_alloc(self._handle,
+                                      ctypes.c_size_t(nbytes))
+        if not ptr:
+            return None, None  # exhausted — caller falls back
+        buf = (ctypes.c_char * nbytes).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
+        return arr, ptr
+
+    def free(self, ptr):
+        self._lib.ptarena_free(self._handle, ctypes.c_void_p(ptr))
+
+    def in_use(self):
+        return int(self._lib.ptarena_in_use(self._handle))
+
+    def peak(self):
+        return int(self._lib.ptarena_peak(self._handle))
+
+    def destroy(self):
+        if self._handle:
+            self._lib.ptarena_destroy(self._handle)
+            self._handle = None
+
+
+class StagedReader:
+    """Drop-in reader: ``staged()`` yields ready-to-run feed dicts.
+
+    reader: yields batches (lists of samples if ``feeder`` given, else
+    feed dicts of numpy arrays).
+    feeder: optional DataFeeder applied on the staging thread.
+    depth: queue depth (batches staged ahead of the consumer).
+    capacity_mb: arena size; a batch set larger than this falls back to
+    plain numpy staging for the overflowing arrays.
+    device_put: dispatch jax.device_put on the staging thread (H2D in
+    flight before the consumer sees the feed).
+    """
+
+    def __init__(self, reader, feeder=None, depth=2, capacity_mb=256,
+                 device_put=True, free_lag=2):
+        self.reader = reader
+        self.feeder = feeder
+        self.depth = max(1, int(depth))
+        self.device_put = device_put
+        self.free_lag = max(0, int(free_lag))
+        self.records = []      # [(stage_start, stage_end)] per batch
+        self.staged_batches = 0
+        self.arena_active = False
+        self._arena = None
+        self._active = None    # (thread, stop, queue) of a live fill
+        try:
+            self._arena = _Arena(int(capacity_mb) * (1 << 20))
+            self.arena_active = True
+        except Exception:
+            self._arena = None
+
+    # -- stats ----------------------------------------------------------
+    def stats(self):
+        s = {"staged_batches": self.staged_batches,
+             "arena_active": self.arena_active}
+        if self._arena is not None:
+            s["arena_peak_bytes"] = self._arena.peak()
+            s["arena_in_use_bytes"] = self._arena.in_use()
+        return s
+
+    # -- staging thread --------------------------------------------------
+    def _stage_feed(self, feed):
+        """Copy arrays into arena blocks; returns (staged_feed, ptrs)."""
+        staged, ptrs = {}, []
+        for name, value in feed.items():
+            arr = np.asarray(value)
+            if self._arena is not None and arr.nbytes > 0:
+                dst, ptr = self._arena.alloc_array(arr.shape, arr.dtype,
+                                                   arr.nbytes)
+            else:
+                dst, ptr = None, None
+            if dst is None:
+                dst = np.array(arr, copy=True)  # fallback staging
+            else:
+                np.copyto(dst, arr)
+                ptrs.append(ptr)
+            if self.device_put:
+                import jax
+                dst = jax.device_put(dst)
+            staged[name] = dst
+        return staged, ptrs
+
+    def _fill(self, q, stop):
+        try:
+            it = iter(self.reader())
+            while not stop.is_set():
+                t0 = time.perf_counter()  # window includes reader pull
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                feed = self.feeder.feed(batch) if self.feeder else batch
+                staged, ptrs = self._stage_feed(feed)
+                self.records.append((t0, time.perf_counter()))
+                self.staged_batches += 1
+                q.put((staged, ptrs))
+        except Exception as e:  # surface in the consumer
+            q.put(e)
+        finally:
+            q.put(_END)
+
+    # -- consumer --------------------------------------------------------
+    def __call__(self):
+        q = _queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        t = threading.Thread(target=self._fill, args=(q, stop),
+                             daemon=True)
+        self._active = (t, stop, q)
+        t.start()
+        pending = collections.deque()  # ptr lists awaiting recycle
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                staged, ptrs = item
+                # recycle arena blocks free_lag batches behind: their
+                # consuming steps are dispatched and device-serialized
+                pending.append(ptrs)
+                while len(pending) > self.free_lag + 1:
+                    for p in pending.popleft():
+                        self._arena.free(p)
+                yield staged
+        finally:
+            self._shutdown(t, stop, q, pending)
+
+    def _shutdown(self, t, stop, q, pending):
+        """Stop + JOIN the fill thread, then recycle every arena block.
+        The join makes a subsequent close() (arena destroy) safe — no
+        producer can be mid-copy into arena memory afterwards."""
+        stop.set()
+        # drain so a producer blocked on q.put can observe stop and exit
+        while t.is_alive():
+            try:
+                item = q.get_nowait()
+                if isinstance(item, tuple):
+                    pending.append(item[1])
+            except _queue.Empty:
+                pass
+            t.join(timeout=0.05)
+        try:
+            while True:
+                item = q.get_nowait()
+                if isinstance(item, tuple):
+                    pending.append(item[1])
+        except _queue.Empty:
+            pass
+        self._active = None
+        if self._arena is not None:
+            import jax
+            try:  # best-effort: let in-flight transfers complete
+                jax.effects_barrier()
+            except Exception:
+                pass
+            for ptrs in pending:
+                for p in ptrs:
+                    self._arena.free(p)
+
+    def close(self):
+        if self._active is not None:
+            # consumer abandoned the generator mid-pass (exception /
+            # interrupt): shut the producer down before freeing memory
+            t, stop, q = self._active
+            self._shutdown(t, stop, q, collections.deque())
+        if self._arena is not None:
+            self._arena.destroy()
+            self._arena = None
+            self.arena_active = False

@@ -96,8 +96,12 @@ class Trainer:
 
     def train_batch(self, batch):
         """One donated-step train batch; returns {metric: value}."""
-        self.startup()
         feed = self.feeder.feed(batch) if self.feeder else batch
+        return self._train_feed(feed)
+
+    def _train_feed(self, feed):
+        """One step from an already-assembled feed dict."""
+        self.startup()
         names, vars_ = self._fetches()
         with timer("trainOneBatch"):
             vals = self.exe.run(self.main_program, feed=feed,
@@ -115,25 +119,53 @@ class Trainer:
                                 np.asarray(v) for v in vals]))
 
     def train(self, reader, num_passes=1, event_handler=None,
-              prefetch=8):
-        """Pass/batch loop with events (v2 SGD.train parity)."""
+              prefetch=8, staging=True):
+        """Pass/batch loop with events (v2 SGD.train parity).
+
+        With ``staging`` (default), batches are assembled on a
+        background thread into native buddy-arena host buffers and
+        device_put ahead of consumption (reader/staging.py — the async
+        double-buffer DataProvider analog); falls back to the plain
+        Python prefetch queue when the native arena is unavailable.
+        """
         self.startup()
         event_handler = event_handler or (lambda e: None)
-        for pass_id in range(num_passes):
-            event_handler(BeginPass(pass_id))
-            batched = _reader.buffered(reader, prefetch) if prefetch \
-                else reader
-            last_metrics = {}
-            for batch_id, batch in enumerate(batched()):
-                event_handler(BeginIteration(pass_id, batch_id))
-                metrics = self.train_batch(batch)
-                last_metrics = metrics
-                event_handler(EndIteration(pass_id, batch_id,
-                                           self.step_id, metrics))
-            if self.checkpoint_dir:
-                _io.save_checkpoint(self.exe, self.checkpoint_dir,
-                                    self.step_id, self.main_program)
-            event_handler(EndPass(pass_id, last_metrics))
+        staged = None
+        if staging and prefetch:
+            from .reader.staging import StagedReader
+            staged = StagedReader(reader, feeder=self.feeder,
+                                  depth=prefetch)
+            if not staged.arena_active:
+                staged = None  # native arena unavailable
+        batches = None
+        try:
+            for pass_id in range(num_passes):
+                event_handler(BeginPass(pass_id))
+                if staged is not None:
+                    batches = staged()
+                    run_one = self._train_feed
+                else:
+                    batched = _reader.buffered(reader, prefetch) \
+                        if prefetch else reader
+                    batches = batched()
+                    run_one = self.train_batch
+                last_metrics = {}
+                for batch_id, batch in enumerate(batches):
+                    event_handler(BeginIteration(pass_id, batch_id))
+                    metrics = run_one(batch)
+                    last_metrics = metrics
+                    event_handler(EndIteration(pass_id, batch_id,
+                                               self.step_id, metrics))
+                if self.checkpoint_dir:
+                    _io.save_checkpoint(self.exe, self.checkpoint_dir,
+                                        self.step_id, self.main_program)
+                event_handler(EndPass(pass_id, last_metrics))
+        finally:
+            if staged is not None:
+                if batches is not None:
+                    batches.close()  # stop+join the fill thread first
+                stat_set.set_gauges(staged.stats())
+                staged.close()
 
     def test(self, reader, test_program, fetch_dict):
         """Average fetches over a test reader (Tester parity)."""

@@ -33,6 +33,7 @@ class StatSet:
     def __init__(self, name="GlobalStatInfo"):
         self.name = name
         self._stats = {}
+        self._gauges = {}
         self._lock = threading.Lock()
 
     def add(self, key, dt):
@@ -50,6 +51,16 @@ class StatSet:
     def reset(self):
         with self._lock:
             self._stats.clear()
+            self._gauges = {}
+
+    def set_gauges(self, gauges):
+        """Record point-in-time values (e.g. arena peak bytes)."""
+        with self._lock:
+            self._gauges.update(gauges)
+
+    def gauges(self):
+        with self._lock:
+            return dict(self._gauges)
 
     def report(self):
         """Sorted summary (total desc), like StatSet::printAllStatus."""
@@ -66,6 +77,8 @@ class StatSet:
                     s.total / s.count * 1e3 if s.count else 0.0,
                     s.vmax * 1e3,
                     s.vmin * 1e3 if s.count else 0.0))
+            for key, v in sorted(self._gauges.items()):
+                lines.append("%-32s %s" % (key, v))
         return "\n".join(lines)
 
     def items(self):
