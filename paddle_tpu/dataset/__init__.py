@@ -7,3 +7,4 @@ otherwise — same shapes, dtypes, vocab sizes, and iteration contract."""
 from . import common  # noqa: F401
 from . import mnist, cifar, uci_housing, imdb, imikolov, movielens  # noqa
 from . import wmt14, mq2007  # noqa: F401
+from . import conll05, flowers, voc2012, sentiment  # noqa: F401

@@ -1,0 +1,69 @@
+"""CoNLL-2005 semantic role labeling (reference
+``python/paddle/v2/dataset/conll05.py``): each sample is nine aligned
+sequences — (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_ids,
+mark, label_ids) — where the five ctx_* features and pred_ids repeat one
+value over the sentence length, mark is 0/1 near the predicate, and
+labels are BIO SRL tags. ``get_dict()`` returns (word, verb, label)
+dicts; ``get_embedding()`` a [vocab, 32] matrix."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["get_dict", "get_embedding", "test"]
+
+_WORDS = 5000
+_VERBS = 300
+# BIO tagset: O + B-/I- over 32 roles (reference label dict ~ 67 tags)
+_ROLES = 32
+
+
+def get_dict():
+    word_dict = {"<unk>": 0, "eos": 1,
+                 **{"w%d" % i: i for i in range(2, _WORDS)}}
+    verb_dict = {"v%d" % i: i for i in range(_VERBS)}
+    label_dict = {"O": 0}
+    for r in range(_ROLES):
+        label_dict["B-A%d" % r] = 1 + 2 * r
+        label_dict["I-A%d" % r] = 2 + 2 * r
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    rs = np.random.RandomState(7)
+    return (rs.randn(_WORDS, 32) * 0.1).astype("float32")
+
+
+def _reader(split, n):
+    def reader():
+        s = common.Synthesizer("conll05st", split, n)
+        for _ in range(n):
+            ln = int(s.rs.randint(5, 40))
+            words = s.rs.randint(2, _WORDS, ln).astype("int64")
+            vpos = int(s.rs.randint(0, ln))
+            verb = int(s.rs.randint(0, _VERBS))
+
+            def ctx(off):
+                p = vpos + off
+                return int(words[p]) if 0 <= p < ln else 1  # eos
+
+            mark = np.zeros(ln, dtype="int64")
+            mark[max(0, vpos - 2):vpos + 3] = 1
+            # labels: role spans around the predicate, O elsewhere
+            labels = np.zeros(ln, dtype="int64")
+            role = int(s.rs.randint(0, _ROLES))
+            span = int(s.rs.randint(1, 4))
+            start = max(0, vpos - span)
+            labels[start] = 1 + 2 * role           # B-
+            labels[start + 1:vpos + 1] = 2 + 2 * role  # I-
+            yield (words.tolist(),
+                   [ctx(-2)] * ln, [ctx(-1)] * ln, [ctx(0)] * ln,
+                   [ctx(1)] * ln, [ctx(2)] * ln,
+                   [verb] * ln, mark.tolist(), labels.tolist())
+    return reader
+
+
+def test():
+    """Reference note kept: the CoNLL05 train set is not free, so the
+    test split is used for training (conll05.py:204)."""
+    return _reader("test", 1024)

@@ -1,0 +1,35 @@
+"""Oxford 102 Flowers (reference ``python/paddle/v2/dataset/flowers.py``):
+train/valid/test readers of (image CHW float32, label 0..101)."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "valid"]
+
+CLASSES = 102
+_SHAPE = (3, 224, 224)
+
+
+def _reader(split, n):
+    def reader():
+        s = common.Synthesizer("flowers", split, n)
+        for _ in range(n):
+            label = int(s.rs.randint(0, CLASSES))
+            img = s.rs.rand(*_SHAPE).astype("float32")
+            # class-dependent hue bias so models can actually fit
+            img[label % 3] += (label / CLASSES)
+            yield img, label
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("train", 2048)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("test", 256)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("valid", 256)

@@ -1,0 +1,40 @@
+"""Movie-review sentiment (reference
+``python/paddle/v2/dataset/sentiment.py``, NLTK movie_reviews corpus):
+``get_word_dict()`` + train/test readers of (word-id list, label 0/1)."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["get_word_dict", "train", "test"]
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+_VOCAB = 8000
+
+
+def get_word_dict():
+    """Sorted-by-frequency word dict (reference sentiment.py:53)."""
+    return {"w%d" % i: i for i in range(_VOCAB)}
+
+
+def _reader(split, n):
+    def reader():
+        s = common.Synthesizer("sentiment", split, n)
+        for _ in range(n):
+            label = int(s.rs.randint(0, 2))
+            ln = int(s.rs.randint(30, 200))
+            ids = s.rs.randint(20, _VOCAB, ln)
+            if label:  # positive marker tokens
+                pos = s.rs.randint(0, ln, max(1, ln // 40))
+                ids[pos] = 7
+            yield ids.astype("int64").tolist(), label
+    return reader
+
+
+def train():
+    return _reader("train", NUM_TRAINING_INSTANCES)
+
+
+def test():
+    return _reader("test", NUM_TOTAL_INSTANCES - NUM_TRAINING_INSTANCES)

@@ -1,0 +1,44 @@
+"""PASCAL VOC2012 segmentation (reference
+``python/paddle/v2/dataset/voc2012.py``): readers of
+(image CHW float32, label mask HW int32 with 21 classes + 255 ignore)."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "val"]
+
+CLASSES = 21
+IGNORE = 255
+_H = _W = 96
+
+
+def _reader(split, n):
+    def reader():
+        s = common.Synthesizer("voc2012", split, n)
+        for _ in range(n):
+            img = s.rs.rand(3, _H, _W).astype("float32")
+            mask = np.zeros((_H, _W), dtype="int32")
+            # a few rectangular object regions
+            for _ in range(int(s.rs.randint(1, 4))):
+                c = int(s.rs.randint(1, CLASSES))
+                y0, x0 = s.rs.randint(0, _H - 16), s.rs.randint(0, _W - 16)
+                h, w = s.rs.randint(8, 32), s.rs.randint(8, 32)
+                mask[y0:y0 + h, x0:x0 + w] = c
+                img[:, y0:y0 + h, x0:x0 + w] += c / CLASSES
+            # thin ignore border like the reference's void boundary
+            mask[0], mask[-1], mask[:, 0], mask[:, -1] = (IGNORE,) * 4
+            yield img, mask
+    return reader
+
+
+def train():
+    return _reader("train", 1024)
+
+
+def test():
+    return _reader("test", 128)
+
+
+def val():
+    return _reader("val", 128)

@@ -14,7 +14,8 @@ from ..param_attr import ParamAttr
 from ..initializer import ConstantInitializer, NormalInitializer
 
 __all__ = [
-    "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose", "pool2d",
+    "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose",
+    "conv3d_transpose", "factorization_machine", "pool2d",
     "pool3d", "batch_norm", "layer_norm", "dropout", "cross_entropy",
     "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
     "square_error_cost", "accuracy", "auc", "topk", "matmul", "reduce_sum",
@@ -739,3 +740,48 @@ def ctc_greedy_decoder(input, blank, length, name=None, **kwargs):
                               "OutputLength": [out_len.name]},
                      attrs={"blank": blank})
     return out, out_len
+
+
+def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     dilation=1, param_attr=None, bias_attr=None, act=None,
+                     name=None, **kwargs):
+    """3-D transposed conv (reference conv3d_transpose /
+    conv_transpose_op.cc)."""
+    helper = LayerHelper("conv3d_transpose", act=act, name=name, **kwargs)
+    num_channels = input.shape[1]
+    fs = [filter_size] * 3 if isinstance(filter_size, int) \
+        else list(filter_size)
+    stride = [stride] * 3 if isinstance(stride, int) else list(stride)
+    padding = [padding] * 3 if isinstance(padding, int) else list(padding)
+    dilation = [dilation] * 3 if isinstance(dilation, int) \
+        else list(dilation)
+    w = helper.create_parameter(param_attr,
+                                shape=[num_channels, num_filters] + fs,
+                                dtype=input.dtype)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="conv3d_transpose",
+                     inputs={"Input": [input.name], "Filter": [w.name]},
+                     outputs={"Output": [out.name]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation})
+    if bias_attr is not False:
+        out = helper.append_bias_op(out, ParamAttr.to_attr(bias_attr),
+                                    dim_start=1, dim_end=2)
+    return helper.append_activation(out)
+
+
+def factorization_machine(input, factor_size, param_attr=None, act=None,
+                          name=None, **kwargs):
+    """Second-order factorization machine interaction term (reference
+    FactorizationMachineLayer.cpp): out[n] = 0.5 * sum_k((x@V)_k^2 -
+    (x^2@V^2)_k). Combine with an fc for the linear term."""
+    helper = LayerHelper("factorization_machine", act=act, name=name,
+                         **kwargs)
+    dim = input.shape[-1]
+    v = helper.create_parameter(param_attr, shape=[dim, factor_size],
+                                dtype=input.dtype)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="factorization_machine",
+                     inputs={"X": [input.name], "V": [v.name]},
+                     outputs={"Out": [out.name]})
+    return helper.append_activation(out)

@@ -21,7 +21,9 @@ __all__ = ["sequence_mask", "sequence_pool", "sequence_first_step",
            "sequence_last_step", "sequence_softmax", "sequence_expand",
            "sequence_conv", "dynamic_lstm", "dynamic_gru", "gru_unit",
            "lstm_unit", "sequence_reverse", "sequence_erase_pad",
-           "sequence_slice", "sequence_concat"]
+           "sequence_slice", "sequence_concat", "nested_sequence_mask",
+           "nested_sequence_pool", "sub_seq", "sub_nested_seq",
+           "nested_flatten", "nested_unflatten"]
 
 
 def sequence_mask(length, maxlen, dtype="float32", **kwargs):
@@ -67,13 +69,17 @@ def sequence_softmax(input, length=None, **kwargs):
     return out
 
 
-def sequence_expand(x, y, **kwargs):
-    """Broadcast per-sequence rows of ``x`` [b, d] across ``y``'s time axis
-    (padded analog of sequence_expand_op)."""
+def sequence_expand(x, y, y_length=None, **kwargs):
+    """Expand per-sequence rows of ``x`` [b, d] across ``y``'s time axis
+    (padded analog of sequence_expand_op). With ``y_length`` the repeat
+    count varies per row (reference per-sequence lod(y) repeats): rows
+    past a row's length are zeroed."""
     helper = LayerHelper("sequence_expand", **kwargs)
+    inputs = {"X": [x.name], "Y": [y.name]}
+    if y_length is not None:
+        inputs["Length"] = [y_length.name]
     out = helper.create_tmp_variable(x.dtype)
-    helper.append_op(type="sequence_expand",
-                     inputs={"X": [x.name], "Y": [y.name]},
+    helper.append_op(type="sequence_expand", inputs=inputs,
                      outputs={"Out": [out.name]})
     return out
 
@@ -253,3 +259,86 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
                      outputs={"H": [h.name], "C": [c.name]},
                      attrs={"forget_bias": forget_bias})
     return h, c
+
+
+# -- nested (2-level) sequences ---------------------------------------------
+# Convention (ops/nested_ops.py; reference Argument.h:84-90
+# subSequenceStartPositions, RecurrentGradientMachine.cpp:380-383):
+# (data[B, S, T, ...], seq_len[B], sub_len[B, S]).
+
+def nested_sequence_mask(seq_len, sub_len, max_sub, maxlen, **kwargs):
+    """Returns (outer[B,S], inner[B,S,T]) float masks."""
+    helper = LayerHelper("nested_sequence_mask", **kwargs)
+    outer = helper.create_tmp_variable("float32", stop_gradient=True)
+    inner = helper.create_tmp_variable("float32", stop_gradient=True)
+    helper.append_op(type="nested_sequence_mask",
+                     inputs={"SeqLen": [seq_len.name],
+                             "SubLen": [sub_len.name]},
+                     outputs={"Outer": [outer.name],
+                              "Inner": [inner.name]},
+                     attrs={"max_sub": max_sub, "maxlen": maxlen})
+    return outer, inner
+
+
+def nested_sequence_pool(input, sub_len, pool_type="average", **kwargs):
+    """Pool the innermost level: [B,S,T,...] -> [B,S,...] (reference
+    sequence_pool over a 2-level LoD). Chain with sequence_pool(.,
+    length=seq_len) for the outer level."""
+    helper = LayerHelper("nested_sequence_pool", **kwargs)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="nested_sequence_pool",
+                     inputs={"X": [input.name], "SubLen": [sub_len.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"pool_type": pool_type})
+    return out
+
+
+def sub_seq(input, offset, size, max_size, **kwargs):
+    """Per-sequence window slice (reference SubSequenceLayer): returns
+    ([B, max_size, ...] left-packed, new_length[B])."""
+    helper = LayerHelper("sub_seq", **kwargs)
+    out = helper.create_tmp_variable(input.dtype)
+    out_len = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op(type="sub_seq",
+                     inputs={"X": [input.name], "Offset": [offset.name],
+                             "Size": [size.name]},
+                     outputs={"Out": [out.name], "OutLen": [out_len.name]},
+                     attrs={"max_size": max_size})
+    return out, out_len
+
+
+def sub_nested_seq(input, sub_len, selected, **kwargs):
+    """Select sub-sequences by per-sequence indices (reference
+    SubNestedSequenceLayer): ([B,S,T,...], [B,S], [B,K]) ->
+    ([B,K,T,...], [B,K]); negative index -> empty sub-sequence."""
+    helper = LayerHelper("sub_nested_seq", **kwargs)
+    out = helper.create_tmp_variable(input.dtype)
+    out_sub = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op(type="sub_nested_seq",
+                     inputs={"X": [input.name], "SubLen": [sub_len.name],
+                             "Selected": [selected.name]},
+                     outputs={"Out": [out.name],
+                              "OutSubLen": [out_sub.name]})
+    return out, out_sub
+
+
+def nested_flatten(input, sub_len, **kwargs):
+    """[B,S,T,...] -> ([B*S,T,...], [B*S]) — run any level-1 sequence op
+    (dynamic_lstm/gru, sequence_pool...) over the sub-sequences, then
+    nested_unflatten back. This is the TPU-native nested recurrent
+    group: the reference clones per-frame sub-networks with scatter/
+    gather agents (RecurrentGradientMachine.cpp:380-383,462-529); here
+    the inner level is just a bigger batch."""
+    from . import tensor as _tensor
+    shape = list(input.shape)
+    flat = _tensor.reshape(input, [-1] + shape[2:], **kwargs)
+    flat_len = _tensor.reshape(sub_len, [-1], **kwargs)
+    return flat, flat_len
+
+
+def nested_unflatten(input, batch, max_sub, **kwargs):
+    """[B*S, ...] -> [B, S, ...] (inverse of nested_flatten's batch
+    collapse, after the inner-level op)."""
+    from . import tensor as _tensor
+    shape = list(input.shape)
+    return _tensor.reshape(input, [batch, max_sub] + shape[1:], **kwargs)

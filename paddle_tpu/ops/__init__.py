@@ -14,6 +14,7 @@ from . import (  # noqa: F401
     random_ops,
     metric_ops,
     sequence_ops,
+    nested_ops,
     seq2seq_ops,
     control_flow_ops,
     attention_ops,

@@ -1,0 +1,126 @@
+"""Nested (2-level) sequence ops over padded batches.
+
+The TPU-native realization of the reference's 2-level LoD semantics
+(``paddle/parameter/Argument.h:84-90`` ``subSequenceStartPositions``;
+``paddle/framework/lod_tensor.h:58-70`` 2-level LoD; nested recurrent
+machinery ``RecurrentGradientMachine.cpp:380-383``
+``createInFrameInfo_subseq``; layers ``SubSequenceLayer`` /
+``SubNestedSequenceLayer``, SURVEY A.2 sub_seq / sub_nested_seq):
+
+A nested sequence batch is ``(data[B, S, T, ...], seq_len[B],
+sub_len[B, S])`` — B outer sequences (articles) of up to S sub-sequences
+(sentences) of up to T elements (words). ``seq_len`` counts valid
+sub-sequences, ``sub_len`` counts valid elements per sub-sequence
+(0 where the sub-sequence itself is padding). Static shapes for XLA;
+masks reproduce the reference's ragged semantics exactly (padding
+invariance is tested).
+
+The nested recurrent group collapses to reshapes: [B,S,T,D] -> [B*S,T,D]
+runs any level-1 RNN over elements (sub_len flattened), and the
+[B,S,H] encodings run a level-1 RNN over sub-sequences with seq_len —
+see layers.sequence nested_* helpers and the hierarchical-model test.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _inner_mask(sub_len, t, dtype=jnp.float32):
+    """[B, S, T] mask from sub_len [B, S]."""
+    return (jnp.arange(t)[None, None, :] <
+            sub_len[:, :, None]).astype(dtype)
+
+
+@register_op("nested_sequence_mask")
+def _nested_sequence_mask(ctx):
+    seq_len = ctx.input("SeqLen").reshape(-1)          # [B]
+    sub_len = ctx.input("SubLen")                      # [B, S]
+    s, t = ctx.attr("max_sub"), ctx.attr("maxlen")
+    outer = (jnp.arange(s)[None, :] < seq_len[:, None]).astype(
+        jnp.float32)
+    inner = _inner_mask(sub_len, t) * outer[:, :, None]
+    return {"Outer": outer, "Inner": inner}
+
+
+@register_op("nested_sequence_pool")
+def _nested_sequence_pool(ctx):
+    """Pool the INNERMOST level: [B,S,T,...] -> [B,S,...] (the reference
+    sequence_pool on a 2-level LoD pools within each sub-sequence)."""
+    x = ctx.input("X")                                  # [B,S,T,...]
+    sub_len = ctx.input("SubLen")                       # [B,S]
+    pool = ctx.attr("pool_type", "average").lower()
+    t = x.shape[2]
+    m = _inner_mask(sub_len, t, x.dtype)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 3))
+    count = jnp.maximum(jnp.sum(m, axis=2), 1.0)
+    if pool in ("average", "avg"):
+        out = jnp.sum(x * m, axis=2) / count
+    elif pool == "sum":
+        out = jnp.sum(x * m, axis=2)
+    elif pool == "sqrt":
+        out = jnp.sum(x * m, axis=2) / jnp.sqrt(count)
+    elif pool == "max":
+        neg = jnp.asarray(jnp.finfo(x.dtype).min, dtype=x.dtype)
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=2)
+        out = out * (jnp.sum(m, axis=2) > 0).astype(x.dtype)  # empty->0
+    elif pool == "first":
+        out = x[:, :, 0] * (sub_len > 0).reshape(
+            sub_len.shape + (1,) * (x.ndim - 3)).astype(x.dtype)
+    elif pool == "last":
+        idx = jnp.maximum(sub_len - 1, 0).astype(jnp.int32)
+        out = jnp.take_along_axis(
+            x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=2)
+        out = jnp.squeeze(out, axis=2)
+        out = out * (sub_len > 0).reshape(
+            sub_len.shape + (1,) * (x.ndim - 3)).astype(x.dtype)
+    else:
+        raise ValueError("unknown pool_type %r" % pool)
+    return {"Out": out}
+
+
+@register_op("sub_seq")
+def _sub_seq(ctx):
+    """Per-sequence window slice (reference SubSequenceLayer / gserver
+    sub_seq: offsets+sizes given per sequence): out[b] =
+    x[b, off[b]:off[b]+size[b]], left-packed into [B, max_size, ...]
+    with new length = size."""
+    x = ctx.input("X")                                  # [B,T,...]
+    off = ctx.input("Offset").reshape(-1)               # [B] int
+    size = ctx.input("Size").reshape(-1)                # [B] int
+    max_size = ctx.attr("max_size")
+    t = x.shape[1]
+    pos = off[:, None] + jnp.arange(max_size)[None, :]  # [B, max_size]
+    # a window running past either end is masked out, not clamped
+    # (clamping would silently duplicate the boundary step)
+    valid = (jnp.arange(max_size)[None, :] < size[:, None]) \
+        & (pos >= 0) & (pos < t)
+    pos = jnp.clip(pos, 0, t - 1)
+    out = jnp.take_along_axis(
+        x, pos.reshape(pos.shape + (1,) * (x.ndim - 2)), axis=1)
+    vm = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
+    out = jnp.where(vm, out, jnp.zeros((), x.dtype))
+    return {"Out": out, "OutLen": size.astype(jnp.int32)}
+
+
+@register_op("sub_nested_seq")
+def _sub_nested_seq(ctx):
+    """Select sub-sequences by per-sequence indices (reference
+    SubNestedSequenceLayer): x[B,S,T,...] + selected[B,K] ->
+    out[B,K,T,...]; a negative index yields an empty sub-sequence.
+    Output sub_len gathers accordingly."""
+    x = ctx.input("X")                                  # [B,S,T,...]
+    sub_len = ctx.input("SubLen")                       # [B,S]
+    sel = ctx.input("Selected")                         # [B,K] int
+    s = x.shape[1]
+    valid = sel >= 0
+    idx = jnp.clip(sel, 0, s - 1).astype(jnp.int32)
+    out = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    vm = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
+    out = jnp.where(vm, out, jnp.zeros((), x.dtype))
+    new_sub = jnp.where(valid,
+                        jnp.take_along_axis(sub_len, idx, axis=1),
+                        0).astype(jnp.int32)
+    return {"Out": out, "OutSubLen": new_sub}

@@ -74,6 +74,38 @@ def _conv2d_transpose(ctx):
     return {"Output": out}
 
 
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx):
+    """Fractionally-strided 3-D conv (reference conv3d_transpose op,
+    conv_transpose_op.cc): same gradient-of-conv construction as
+    conv2d_transpose, one more spatial dim."""
+    x, w = ctx.input("Input"), ctx.input("Filter")  # w: [in,out,kd,kh,kw]
+    strides = tuple(ctx.attr("strides", [1, 1, 1]))
+    pads = tuple(ctx.attr("paddings", [0, 0, 0]))
+    dils = tuple(ctx.attr("dilations", [1, 1, 1]))
+    ks = w.shape[2:]
+    w_fb = jnp.transpose(w, (1, 0, 2, 3, 4))[:, :, ::-1, ::-1, ::-1]
+    out = jax.lax.conv_general_dilated(
+        x, w_fb, window_strides=(1, 1, 1),
+        padding=[(d * (k - 1) - p, d * (k - 1) - p)
+                 for k, p, d in zip(ks, pads, dils)],
+        lhs_dilation=strides, rhs_dilation=dils,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": out}
+
+
+@register_op("factorization_machine")
+def _factorization_machine(ctx):
+    """Second-order FM interaction (reference
+    FactorizationMachineLayer.cpp): out = 0.5 * sum_k((x@V)_k^2 -
+    (x^2@V^2)_k) over factor dim."""
+    x, v = ctx.input("X"), ctx.input("V")  # x: [..., D]; v: [D, K]
+    xv = x @ v
+    x2v2 = jnp.square(x) @ jnp.square(v)
+    return {"Out": 0.5 * jnp.sum(jnp.square(xv) - x2v2, axis=-1,
+                                 keepdims=True)}
+
+
 def _pool(x, ksize, strides, pads, pooling_type, exclusive=True,
           global_pooling=False, ceil_mode=False):
     spatial = x.shape[2:]

@@ -93,10 +93,20 @@ def _sequence_softmax(ctx):
 
 @register_op("sequence_expand")
 def _sequence_expand(ctx):
+    """Expand each row of x to match y's per-row sequence length
+    (reference sequence_expand_op.h: row i repeated lod(y)[i] times).
+    With a Length input (y's lengths) the repeat count VARIES per row:
+    out[b, r] = x[b] for r < length[b], zeros beyond (padded-batch
+    realization of the ragged expand); without it, uniform broadcast."""
     x, y = ctx.input("X"), ctx.input("Y")  # x: [b, d]; y: [b, t, ...]
     t = y.shape[1]
-    return {"Out": jnp.broadcast_to(x[:, None], (x.shape[0], t) +
-                                    x.shape[1:])}
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], t) + x.shape[1:])
+    if ctx.has_input("Length"):
+        length = ctx.input("Length").reshape(-1)
+        mask = (jnp.arange(t)[None, :] < length[:, None])
+        mask = mask.reshape(mask.shape + (1,) * (x.ndim - 1))
+        out = jnp.where(mask, out, jnp.zeros((), x.dtype))
+    return {"Out": out}
 
 
 @register_op("sequence_reverse")

@@ -21,7 +21,7 @@ __all__ = ["SGD", "Momentum", "Adagrad", "Adam", "Adamax", "DecayedAdagrad",
            "MomentumOptimizer", "AdagradOptimizer", "AdamOptimizer",
            "AdamaxOptimizer", "DecayedAdagradOptimizer",
            "AdaDeltaOptimizer", "RMSPropOptimizer", "FtrlOptimizer",
-           "Optimizer"]
+           "Optimizer", "ModelAverage"]
 
 
 class Optimizer:
@@ -334,3 +334,103 @@ def append_gradient_clip_ops(params_grads):
     """Apply per-parameter gradient_clip attrs (reference clip.py:102)."""
     from .clip import append_gradient_clip_ops as _impl
     return _impl(params_grads)
+
+
+class ModelAverage:
+    """Parameter averaging for evaluation (reference
+    ``paddle/parameter/AverageOptimizer.h:23`` / fluid ModelAverage):
+    accumulation ops are appended to the main program (in the same
+    donated step as the optimizer update), and ``apply()`` swaps the
+    averaged parameters in around evaluation, ``restore()`` (or leaving
+    the context) swaps the trained values back.
+
+    Differences from the reference, by design: the window is
+    "since construction or the last reset_window()" — the reference's
+    rolling min/max window bookkeeping collapses to an explicit reset,
+    which composes with the one-XLA-step executor without in-graph
+    conditionals.
+    """
+
+    def __init__(self, main_program=None, startup_program=None,
+                 parameter_list=None):
+        main = main_program or default_main_program()
+        startup = startup_program or default_startup_program()
+        block = main.global_block()
+        sblock = startup.global_block()
+        params = block.all_parameters()
+        if parameter_list is not None:
+            wanted = {p if isinstance(p, str) else p.name
+                      for p in parameter_list}
+            params = [p for p in params if p.name in wanted]
+        self._param_names = [p.name for p in params]
+        self._sums = {}
+        cname = unique_name.generate("model_average_count")
+        cvar = block.create_var(name=cname, shape=[1], dtype="float32",
+                                persistable=True, stop_gradient=True)
+        svar = sblock.create_var(name=cname, shape=[1], dtype="float32",
+                                 persistable=True)
+        ConstantInitializer(0.0)(svar, sblock)
+        block.append_op("increment", inputs={"X": [cname]},
+                        outputs={"Out": [cname]}, attrs={"step": 1.0},
+                        infer_shape=False)
+        self._count_name = cname
+        for p in params:
+            sname = unique_name.generate("%s_avg_sum" % p.name)
+            var = block.create_var(name=sname, shape=list(p.shape),
+                                   dtype=p.dtype, persistable=True,
+                                   stop_gradient=True)
+            sv = sblock.create_var(name=sname, shape=list(p.shape),
+                                   dtype=p.dtype, persistable=True)
+            ConstantInitializer(0.0)(sv, sblock)
+            # runs after the optimizer's update of p in the same block
+            block.append_op("elementwise_add",
+                            inputs={"X": [sname], "Y": [p.name]},
+                            outputs={"Out": [sname]}, infer_shape=False)
+            self._sums[p.name] = sname
+        self._backup = None
+
+    def apply(self, scope=None):
+        """Swap averaged parameter values in (context manager)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            self._swap_in(scope)
+            try:
+                yield
+            finally:
+                self.restore(scope)
+        return _ctx()
+
+    def _swap_in(self, scope=None):
+        from .core.scope import global_scope
+        scope = scope or global_scope()
+        count = float(np.asarray(scope.find_var(self._count_name)))
+        if count <= 0:
+            raise RuntimeError("ModelAverage.apply before any step ran")
+        self._backup = {}
+        for pname in self._param_names:
+            self._backup[pname] = scope.find_var(pname)
+            avg = np.asarray(scope.find_var(self._sums[pname])) / count
+            scope.set_var(pname, avg.astype(np.float32, copy=False))
+
+    def restore(self, scope=None):
+        from .core.scope import global_scope
+        scope = scope or global_scope()
+        if self._backup is None:
+            return
+        for pname, val in self._backup.items():
+            scope.set_var(pname, val)
+        self._backup = None
+
+    def reset_window(self, scope=None):
+        """Restart accumulation (the window boundary)."""
+        from .core.scope import global_scope
+        scope = scope or global_scope()
+        scope.set_var(self._count_name,
+                      np.zeros([1], dtype=np.float32))
+        for pname in self._param_names:
+            scope.set_var(self._sums[pname],
+                          np.zeros(
+                              np.asarray(scope.find_var(pname)).shape,
+                              dtype=np.float32))

@@ -89,7 +89,9 @@ class StagedReader:
         self.depth = max(1, int(depth))
         self.device_put = device_put
         self.free_lag = max(0, int(free_lag))
-        self.records = []      # [(stage_start, stage_end)] per batch
+        # recent (stage_start, stage_end) windows; bounded — only the
+        # overlap test and debugging read these
+        self.records = collections.deque(maxlen=1024)
         self.staged_batches = 0
         self.arena_active = False
         self._arena = None

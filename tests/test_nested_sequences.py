@@ -1,0 +1,265 @@
+"""Nested (2-level) sequence semantics (ops/nested_ops.py; reference
+Argument.h:84-90 subSequenceStartPositions, RecurrentGradientMachine.cpp
+:380-383 createInFrameInfo_subseq, SubSequenceLayer /
+SubNestedSequenceLayer).
+
+Covers: inner-level pooling vs numpy, padding invariance (the LoD
+"no-semantic-padding" property), sub_seq / sub_nested_seq selection, the
+variable-repeat sequence_expand, and a hierarchical (sentence->document)
+model training through the nested recurrent group realization."""
+
+import numpy as np
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+
+
+def _ragged_nested(b=3, s=4, t=5, d=2, seed=0):
+    rs = np.random.RandomState(seed)
+    data = rs.randn(b, s, t, d).astype("float32")
+    seq_len = rs.randint(1, s + 1, (b,)).astype("int64")
+    sub_len = np.zeros((b, s), dtype="int64")
+    for i in range(b):
+        for j in range(seq_len[i]):
+            sub_len[i, j] = rs.randint(1, t + 1)
+    # zero out padding so padding-content independence is REAL
+    for i in range(b):
+        for j in range(s):
+            data[i, j, sub_len[i, j]:] = 0.0
+    return data, seq_len, sub_len
+
+
+def _np_inner_pool(data, sub_len, mode):
+    b, s, t, d = data.shape
+    out = np.zeros((b, s, d), dtype="float32")
+    for i in range(b):
+        for j in range(s):
+            n = sub_len[i, j]
+            if n == 0:
+                continue
+            seg = data[i, j, :n]
+            if mode == "average":
+                out[i, j] = seg.mean(0)
+            elif mode == "sum":
+                out[i, j] = seg.sum(0)
+            elif mode == "max":
+                out[i, j] = seg.max(0)
+            elif mode == "last":
+                out[i, j] = seg[-1]
+            elif mode == "first":
+                out[i, j] = seg[0]
+    return out
+
+
+class TestNestedPool:
+    def _run(self, data, seq_len, sub_len, mode, s, t, d):
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=list(data.shape),
+                            append_batch_size=False)
+            sl = layers.data("sub_len", shape=list(sub_len.shape),
+                             dtype="int64", append_batch_size=False)
+            out = layers.nested_sequence_pool(x, sl, pool_type=mode)
+        exe = ptpu.Executor()
+        got, = exe.run(main, feed={"x": data, "sub_len": sub_len},
+                       fetch_list=[out])
+        return got
+
+    def test_inner_pool_matches_numpy(self):
+        data, seq_len, sub_len = _ragged_nested()
+        for mode in ("average", "sum", "max", "last", "first"):
+            got = self._run(data, seq_len, sub_len, mode, 4, 5, 2)
+            want = _np_inner_pool(data, sub_len, mode)
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                       err_msg=mode)
+
+    def test_padding_invariance(self):
+        """Growing S and T padding never changes valid outputs (the
+        2-level LoD no-padding-semantics property)."""
+        data, seq_len, sub_len = _ragged_nested()
+        b, s, t, d = data.shape
+        big = np.zeros((b, s + 2, t + 3, d), dtype="float32")
+        big[:, :s, :t] = data
+        big_sub = np.zeros((b, s + 2), dtype="int64")
+        big_sub[:, :s] = sub_len
+        for mode in ("average", "sum", "max", "last"):
+            small = self._run(data, seq_len, sub_len, mode, s, t, d)
+            grown = self._run(big, seq_len, big_sub, mode, s + 2,
+                              t + 3, d)
+            np.testing.assert_allclose(grown[:, :s], small, rtol=1e-5,
+                                       atol=1e-6, err_msg=mode)
+            assert np.all(grown[:, s:] == 0), mode
+
+
+class TestSubSeqOps:
+    def test_sub_seq_window(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(3, 6, 2).astype("float32")
+        off = np.array([1, 0, 3], dtype="int64")
+        size = np.array([2, 4, 3], dtype="int64")
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            xv = layers.data("x", shape=[3, 6, 2],
+                             append_batch_size=False)
+            ov = layers.data("off", shape=[3], dtype="int64",
+                             append_batch_size=False)
+            sv = layers.data("size", shape=[3], dtype="int64",
+                             append_batch_size=False)
+            out, out_len = layers.sub_seq(xv, ov, sv, max_size=4)
+        exe = ptpu.Executor()
+        got, got_len = exe.run(
+            main, feed={"x": x, "off": off, "size": size},
+            fetch_list=[out, out_len])
+        np.testing.assert_array_equal(got_len, size)
+        for i in range(3):
+            np.testing.assert_allclose(
+                got[i, :size[i]], x[i, off[i]:off[i] + size[i]])
+            assert np.all(got[i, size[i]:] == 0)
+
+    def test_sub_nested_seq_select(self):
+        data, seq_len, sub_len = _ragged_nested()
+        sel = np.array([[1, 0], [2, -1], [0, 2]], dtype="int64")
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            xv = layers.data("x", shape=list(data.shape),
+                             append_batch_size=False)
+            slv = layers.data("sub_len", shape=list(sub_len.shape),
+                              dtype="int64", append_batch_size=False)
+            sev = layers.data("sel", shape=[3, 2], dtype="int64",
+                              append_batch_size=False)
+            out, new_sub = layers.sub_nested_seq(xv, slv, sev)
+        exe = ptpu.Executor()
+        got, got_sub = exe.run(
+            main, feed={"x": data, "sub_len": sub_len, "sel": sel},
+            fetch_list=[out, new_sub])
+        for i in range(3):
+            for k in range(2):
+                j = sel[i, k]
+                if j < 0:
+                    assert got_sub[i, k] == 0
+                    assert np.all(got[i, k] == 0)
+                else:
+                    assert got_sub[i, k] == sub_len[i, j]
+                    np.testing.assert_allclose(got[i, k], data[i, j])
+
+
+class TestVariableSequenceExpand:
+    def test_variable_repeat(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(3, 4).astype("float32")
+        yv = np.zeros((3, 5, 1), dtype="float32")
+        rep = np.array([2, 5, 1], dtype="int64")
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            xv = layers.data("x", shape=[3, 4], append_batch_size=False)
+            yvv = layers.data("y", shape=[3, 5, 1],
+                              append_batch_size=False)
+            rv = layers.data("rep", shape=[3], dtype="int64",
+                             append_batch_size=False)
+            out = layers.sequence_expand(xv, yvv, y_length=rv)
+        exe = ptpu.Executor()
+        got, = exe.run(main, feed={"x": x, "y": yv, "rep": rep},
+                       fetch_list=[out])
+        for i in range(3):
+            for r in range(5):
+                if r < rep[i]:
+                    np.testing.assert_allclose(got[i, r], x[i])
+                else:
+                    assert np.all(got[i, r] == 0)
+
+    def test_variable_repeat_grad(self):
+        """Gradient of the ragged expand sums cotangents over the valid
+        repeats only (reference sequence_expand_grad)."""
+        from paddle_tpu.core.backward import append_backward
+        x = np.ones((2, 3), dtype="float32")
+        rep = np.array([2, 4], dtype="int64")
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            xv = main.global_block().create_parameter(
+                name="exp_x", shape=[2, 3], dtype="float32",
+                initializer=ptpu.initializer.Constant(1.0))
+            sv = startup.global_block().create_var(
+                name="exp_x", shape=[2, 3], dtype="float32",
+                persistable=True)
+            ptpu.initializer.Constant(1.0)(sv, startup.global_block())
+            yvv = layers.data("y", shape=[2, 4, 1],
+                              append_batch_size=False)
+            rv = layers.data("rep", shape=[2], dtype="int64",
+                             append_batch_size=False)
+            out = layers.sequence_expand(xv, yvv, y_length=rv)
+            loss = layers.reduce_sum(out)
+            append_backward(loss, parameter_list=["exp_x"])
+        exe = ptpu.Executor()
+        exe.run(startup)
+        g, = exe.run(main,
+                     feed={"y": np.zeros((2, 4, 1), "float32"),
+                           "rep": rep},
+                     fetch_list=["exp_x@GRAD"])
+        # d sum(out) / dx[i] = repeat_i (each valid copy contributes 1)
+        np.testing.assert_allclose(g, np.array([[2.0] * 3, [4.0] * 3]))
+
+
+class TestHierarchicalModelTrains:
+    def test_nested_rnn_group_trains(self):
+        """SURVEY B.3 nested example: sentences -> inner GRU encoder
+        (nested_flatten + dynamic_gru), documents -> outer GRU over
+        sentence encodings; trains end-to-end."""
+        B, S, T, D, H = 4, 3, 5, 4, 8
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[B, S, T, D],
+                            append_batch_size=False)
+            seq_len = layers.data("seq_len", shape=[B], dtype="int64",
+                                  append_batch_size=False)
+            sub_len = layers.data("sub_len", shape=[B, S], dtype="int64",
+                                  append_batch_size=False)
+            y = layers.data("y", shape=[B, 1], append_batch_size=False)
+            flat, flat_len = layers.nested_flatten(x, sub_len)
+            proj = layers.fc(flat, 3 * H, num_flatten_dims=2)
+            enc = layers.dynamic_gru(proj, H, length=flat_len)
+            enc_last = layers.sequence_pool(enc, "last", length=flat_len)
+            sent = layers.nested_unflatten(enc_last, B, S)
+            sent_proj = layers.fc(sent, 3 * H, num_flatten_dims=2)
+            doc = layers.dynamic_gru(sent_proj, H, length=seq_len)
+            doc_last = layers.sequence_pool(doc, "last", length=seq_len)
+            pred = layers.fc(doc_last, 1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            ptpu.optimizer.Adam(learning_rate=5e-3).minimize(
+                loss, startup_program=startup)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        losses = []
+        for _ in range(150):
+            data, seq_len_v, sub_len_v = _ragged_nested(
+                B, S, T, D, seed=rs.randint(10000))
+            # target: masked sum of all valid elements
+            tot = data.sum(axis=(1, 2, 3)).reshape(-1, 1) * 0.1
+            out, = exe.run(main, feed={"x": data, "seq_len": seq_len_v,
+                                       "sub_len": sub_len_v, "y": tot},
+                           fetch_list=[loss])
+            losses.append(float(out))
+        assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+class TestSubSeqBounds:
+    def test_out_of_range_window_is_masked_not_clamped(self):
+        """A window past the sequence end yields zeros, never duplicated
+        boundary steps."""
+        x = np.arange(10, dtype="float32").reshape(1, 5, 2)
+        off = np.array([3], dtype="int64")
+        size = np.array([4], dtype="int64")  # runs past t=5
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            xv = layers.data("x", shape=[1, 5, 2],
+                             append_batch_size=False)
+            ov = layers.data("off", shape=[1], dtype="int64",
+                             append_batch_size=False)
+            sv = layers.data("size", shape=[1], dtype="int64",
+                             append_batch_size=False)
+            out, _ = layers.sub_seq(xv, ov, sv, max_size=4)
+        exe = ptpu.Executor()
+        got, = exe.run(main, feed={"x": x, "off": off, "size": size},
+                       fetch_list=[out])
+        np.testing.assert_allclose(got[0, :2], x[0, 3:5])
+        assert np.all(got[0, 2:] == 0)  # not x[0,4] repeated

@@ -1,0 +1,83 @@
+"""Network composites (nets.py; reference fluid nets.py +
+trainer_config_helpers/networks.py:1-1813 bidirectional groups and
+simple_attention)."""
+
+import numpy as np
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers, nets
+
+
+class TestBidirectionalGroups:
+    def test_bidirectional_outputs_concat(self):
+        B, T, D, H = 2, 5, 3, 4
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[T, D])
+            ln = layers.data("len", shape=[], dtype="int64")
+            out = nets.bidirectional_gru(x, H, length=ln)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(B, T, D).astype("float32")
+        lv = np.array([5, 3], dtype="int64")
+        got, = exe.run(main, feed={"x": xv, "len": lv},
+                       fetch_list=[out])
+        assert got.shape == (B, T, 2 * H)
+        # backward half ends at padding: rows past length are zero-state
+        # contributions; check fwd != bwd halves (both real)
+        assert np.abs(got[:, :, :H]).sum() > 0
+        assert np.abs(got[:, :, H:]).sum() > 0
+
+    def test_bidirectional_lstm_trains(self):
+        B, T, D, H = 8, 6, 4, 8
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[T, D])
+            ln = layers.data("len", shape=[], dtype="int64")
+            y = layers.data("y", shape=[1])
+            seq = nets.bidirectional_lstm(x, H, length=ln)
+            pooled = layers.sequence_pool(seq, "average", length=ln)
+            pred = layers.fc(pooled, 1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            ptpu.optimizer.Adam(learning_rate=5e-3).minimize(
+                loss, startup_program=startup)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        losses = []
+        for _ in range(150):
+            xv = rs.randn(B, T, D).astype("float32")
+            lv = np.full((B,), T, dtype="int64")
+            yv = xv.mean(axis=(1, 2), keepdims=False).reshape(-1, 1)
+            out, = exe.run(main, feed={"x": xv, "len": lv, "y": yv},
+                           fetch_list=[loss])
+            losses.append(float(out))
+        assert losses[-1] < 0.2 * losses[0], (losses[0], losses[-1])
+
+
+class TestSimpleAttention:
+    def test_attention_weights_mask_and_sum_to_one(self):
+        B, T, H = 3, 6, 4
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            enc = layers.data("enc", shape=[T, H])
+            proj = layers.data("proj", shape=[T, H])
+            state = layers.data("state", shape=[H])
+            ln = layers.data("len", shape=[], dtype="int64")
+            ctx, w = nets.simple_attention(enc, proj, state, length=ln)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(1)
+        lv = np.array([6, 2, 4], dtype="int64")
+        got_ctx, got_w = exe.run(
+            main,
+            feed={"enc": rs.randn(B, T, H).astype("float32"),
+                  "proj": rs.randn(B, T, H).astype("float32"),
+                  "state": rs.randn(B, H).astype("float32"),
+                  "len": lv},
+            fetch_list=[ctx, w])
+        assert got_ctx.shape == (B, H)
+        np.testing.assert_allclose(got_w.sum(axis=1), np.ones(B),
+                                   rtol=1e-5)
+        for i in range(B):
+            assert np.all(got_w[i, lv[i]:] == 0)

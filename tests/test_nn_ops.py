@@ -207,3 +207,51 @@ class TestLrnDropout:
         expect = x.reshape(2, 3, 2, 3, 3).max(axis=2)
         OpTestHarness("maxout", {"X": x},
                       attrs={"groups": 2}).check_output({"Out": expect})
+
+
+class TestConv3dTranspose:
+    def test_shape_and_grad(self):
+        x = RS.randn(1, 2, 3, 3, 3).astype("float32")
+        w = RS.randn(2, 4, 2, 2, 2).astype("float32")  # [in,out,kd,kh,kw]
+        t = OpTestHarness("conv3d_transpose", {"Input": x, "Filter": w},
+                          attrs={"strides": [2, 2, 2],
+                                 "paddings": [0, 0, 0]},
+                          output_slots={"Output": 1})
+        t._build()
+        out, = t.run()
+        # (in-1)*stride - 2*pad + k = 2*2 + 2 = 6
+        assert out.shape == (1, 4, 6, 6, 6)
+        t2 = OpTestHarness("conv3d_transpose", {"Input": x, "Filter": w},
+                           attrs={"strides": [2, 2, 2],
+                                  "paddings": [0, 0, 0]},
+                           output_slots={"Output": 1})
+        t2.check_grad([("Input", 0), ("Filter", 0)],
+                      output_names=["out_Output_0"],
+                      max_relative_error=0.02)
+
+    def test_matches_upsample_identity(self):
+        """k=1,s=1 conv3d_transpose == 1x1x1 conv with swapped io."""
+        x = RS.randn(2, 3, 4, 4, 4).astype("float32")
+        w = RS.randn(3, 5, 1, 1, 1).astype("float32")
+        t = OpTestHarness("conv3d_transpose", {"Input": x, "Filter": w},
+                          attrs={"strides": [1, 1, 1],
+                                 "paddings": [0, 0, 0]},
+                          output_slots={"Output": 1})
+        t._build()
+        out, = t.run()
+        want = np.einsum("ncdhw,co->nodhw", x, w[:, :, 0, 0, 0])
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+class TestFactorizationMachine:
+    def test_matches_numpy_and_grad(self):
+        x = RS.randn(5, 7).astype("float32")
+        v = RS.randn(7, 3).astype("float32")
+        t = OpTestHarness("factorization_machine", {"X": x, "V": v})
+        t._build()
+        out, = t.run()
+        want = 0.5 * (np.square(x @ v) - np.square(x) @ np.square(v)
+                      ).sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+        t2 = OpTestHarness("factorization_machine", {"X": x, "V": v})
+        t2.check_grad([("X", 0), ("V", 0)], max_relative_error=0.02)

@@ -157,3 +157,50 @@ def test_lr_multiplier():
     exe.run(main, feed={"x": target})
     w = np.asarray(ptpu.global_scope().find_var("w"))
     np.testing.assert_allclose(w, 0.1 * np.ones(4), rtol=1e-5)  # 2x lr
+
+
+class TestModelAverage:
+    def test_average_apply_restore(self):
+        """ModelAverage (reference AverageOptimizer.h:23): the applied
+        value equals the mean of post-update params over the window."""
+        import paddle_tpu as ptpu
+        from paddle_tpu import layers
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[2])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(x, 1, bias_attr=False,
+                             param_attr="avg_w")
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            ptpu.optimizer.SGD(learning_rate=0.1).minimize(
+                loss, startup_program=startup)
+            avg = ptpu.optimizer.ModelAverage(main_program=main,
+                                              startup_program=startup)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        scope = ptpu.global_scope()
+        seen = []
+        for _ in range(5):
+            xv = rs.randn(8, 2).astype("float32")
+            yv = (xv.sum(1, keepdims=True) * 0.5).astype("float32")
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            seen.append(np.asarray(scope.find_var("avg_w")).copy())
+        trained = np.asarray(scope.find_var("avg_w")).copy()
+        with avg.apply():
+            applied = np.asarray(scope.find_var("avg_w")).copy()
+            np.testing.assert_allclose(applied,
+                                       np.mean(seen, axis=0),
+                                       rtol=1e-5, atol=1e-6)
+        restored = np.asarray(scope.find_var("avg_w"))
+        np.testing.assert_allclose(restored, trained)
+        # window reset restarts accumulation
+        avg.reset_window()
+        xv = rs.randn(8, 2).astype("float32")
+        yv = (xv.sum(1, keepdims=True) * 0.5).astype("float32")
+        exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        post = np.asarray(scope.find_var("avg_w")).copy()
+        with avg.apply():
+            np.testing.assert_allclose(
+                np.asarray(scope.find_var("avg_w")), post,
+                rtol=1e-5, atol=1e-6)
