@@ -1,0 +1,50 @@
+/* Standalone C serving example (reference
+ * paddle/capi/examples/model_inference/dense/main.c): link libcapi +
+ * libpython, load a saved inference dir, run one batch.
+ *
+ *   gcc infer_main.c -o infer -L../build -lcapi $(python3-config --embed --ldflags)
+ *   PYTHONPATH=<repo>:<site-packages> ./infer <model_dir>
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef struct {
+  const char* name;
+  const void* data;
+  const int64_t* shape;
+  int ndim;
+  int dtype;
+} ptc_tensor;
+
+extern int ptc_init(const char* repo_path);
+extern void* ptc_model_load(const char* dirname);
+extern int ptc_model_forward(void* model, const ptc_tensor* in, int n);
+extern const float* ptc_model_output_data(void* model, int i,
+                                          int64_t* numel);
+extern const char* ptc_model_output_name(void* model, int i);
+extern void ptc_model_release(void* model);
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <model_dir>\n", argv[0]);
+    return 2;
+  }
+  if (ptc_init("") != 0) return 1;
+  void* model = ptc_model_load(argv[1]);
+  if (!model) return 1;
+
+  float x[2 * 4];
+  for (int i = 0; i < 8; i++) x[i] = 0.1f * (float)i;
+  int64_t shape[2] = {2, 4};
+  ptc_tensor in = {"x", x, shape, 2, 0};
+  int n = ptc_model_forward(model, &in, 1);
+  if (n < 1) return 1;
+  int64_t numel = 0;
+  const float* out = ptc_model_output_data(model, 0, &numel);
+  printf("output %s numel=%lld first=%f\n", ptc_model_output_name(model, 0),
+         (long long)numel, out[0]);
+  ptc_model_release(model);
+  printf("C_INFER_OK\n");
+  return 0;
+}

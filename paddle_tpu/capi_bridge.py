@@ -1,0 +1,75 @@
+"""Python side of the C inference API (native/capi.cc).
+
+The reference exposes C serving via ``paddle/capi`` wrapping its C++
+core (``capi/gradient_machine.h:27-73``, ``capi/main.h:27``); here the
+engine IS the XLA executor, so the C ABI wraps it through this bridge:
+capi.cc embeds (or joins) a CPython interpreter and calls these three
+functions. Handles are ints so no Python object crosses the ABI.
+
+Thread-safety: the C side serializes entry through the GIL; each model
+handle owns its Executor (compiled-step cache) and Scope, so concurrent
+requests against different models never share mutable state, and
+against the same model share only the jitted function (thread-safe).
+"""
+
+import threading
+
+import numpy as np
+
+_models = {}
+_next_id = [1]
+_lock = threading.Lock()
+
+_DTYPES = {0: np.float32, 1: np.int32, 2: np.int64}
+
+
+def load_model(dirname):
+    """Load an inference dir (JSON __model__ + params) -> int handle."""
+    from . import io as _io
+    from .core.executor import Executor
+    from .core.scope import Scope, scope_guard
+
+    scope = Scope()
+    exe = Executor()
+    with scope_guard(scope):
+        program, feed_names, fetch_names = _io.load_inference_model(
+            dirname, exe, scope=scope)
+    entry = {"exe": exe, "scope": scope, "program": program,
+             "feed_names": feed_names, "fetch_names": fetch_names,
+             "lock": threading.Lock()}
+    with _lock:
+        handle = _next_id[0]
+        _next_id[0] += 1
+        _models[handle] = entry
+    return handle
+
+
+def forward(handle, inputs):
+    """inputs: [(name, bytes_or_buffer, shape tuple, dtype code)].
+    Returns [(name, float32 C-contiguous array)] for each fetch."""
+    entry = _models[handle]
+    feed = {}
+    for name, buf, shape, dtype_code in inputs:
+        dt = _DTYPES[int(dtype_code)]
+        arr = np.frombuffer(buf, dtype=dt).reshape(
+            [int(s) for s in shape])
+        feed[name] = arr
+    with entry["lock"]:
+        outs = entry["exe"].run(entry["program"], feed=feed,
+                                fetch_list=entry["fetch_names"],
+                                scope=entry["scope"])
+    result = []
+    for name, val in zip(entry["fetch_names"], outs):
+        a = np.ascontiguousarray(np.asarray(val), dtype=np.float32)
+        result.append((name, a, list(a.shape)))
+    return result
+
+
+def release(handle):
+    with _lock:
+        _models.pop(handle, None)
+
+
+def feed_fetch_names(handle):
+    e = _models[handle]
+    return list(e["feed_names"]), list(e["fetch_names"])

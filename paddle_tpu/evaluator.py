@@ -14,7 +14,7 @@ from .core.scope import global_scope
 from .layer_helper import LayerHelper
 from .initializer import ConstantInitializer
 
-__all__ = ["Evaluator", "Accuracy", "ChunkEvaluator"]
+__all__ = ["Evaluator", "Accuracy", "ChunkEvaluator", "DetectionMAP"]
 
 
 class Evaluator:
@@ -130,3 +130,96 @@ class ChunkEvaluator(Evaluator):
         f1 = 2 * precision * recall / (precision + recall) \
             if precision + recall else 0.0
         return precision, recall, f1
+
+
+class DetectionMAP:
+    """Host-side mAP evaluator (reference
+    ``gserver/evaluators/DetectionMAPEvaluator.cpp``; the reference also
+    computes mAP on CPU outside the device graph). Feed per batch:
+    ``update(detections, gt_boxes, gt_labels, gt_counts)`` with
+    detections [N, K, 6] rows (label, score, x1, y1, x2, y2), label -1
+    = empty, and padded ground truth. ``eval()`` returns mAP over the
+    accumulated stream (11-point interpolation by default, or
+    'integral')."""
+
+    def __init__(self, num_classes, overlap_threshold=0.5,
+                 ap_version="11point", background_label=0):
+        self.num_classes = num_classes
+        self.overlap = overlap_threshold
+        self.ap_version = ap_version
+        self.background = background_label
+        self.reset()
+
+    def reset(self, executor=None, scope=None):
+        # per class: list of (score, tp) + GT count
+        self._dets = {c: [] for c in range(self.num_classes)}
+        self._n_gt = {c: 0 for c in range(self.num_classes)}
+
+    @staticmethod
+    def _iou(a, b):
+        ax1, ay1, ax2, ay2 = a
+        bx1, by1, bx2, by2 = b
+        ix = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+        iy = max(0.0, min(ay2, by2) - max(ay1, by1))
+        inter = ix * iy
+        ua = max(0.0, ax2 - ax1) * max(0.0, ay2 - ay1) + \
+            max(0.0, bx2 - bx1) * max(0.0, by2 - by1) - inter
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, detections, gt_boxes, gt_labels, gt_counts):
+        detections = np.asarray(detections)
+        gt_boxes = np.asarray(gt_boxes)
+        gt_labels = np.asarray(gt_labels).reshape(gt_boxes.shape[0], -1)
+        gt_counts = np.asarray(gt_counts).reshape(-1)
+        for n in range(detections.shape[0]):
+            cnt = int(gt_counts[n])
+            # tolerate padded / out-of-range GT labels like detection
+            # rows (label -1 = empty)
+            gts = [(int(gt_labels[n, g]), gt_boxes[n, g])
+                   for g in range(cnt) if int(gt_labels[n, g]) >= 0]
+            for c in set(l for l, _ in gts):
+                self._n_gt[c] = self._n_gt.get(c, 0) + \
+                    sum(1 for l, _ in gts if l == c)
+            used = [False] * cnt
+            rows = [r for r in detections[n] if r[0] >= 0]
+            rows.sort(key=lambda r: -r[1])
+            for r in rows:
+                c = int(r[0])
+                best, best_g = 0.0, -1
+                for g, (gl, gb) in enumerate(gts):
+                    if gl != c or used[g]:
+                        continue
+                    v = self._iou(r[2:6], gb)
+                    if v > best:
+                        best, best_g = v, g
+                tp = best >= self.overlap and best_g >= 0
+                if tp:
+                    used[best_g] = True
+                self._dets.setdefault(c, []).append((float(r[1]), tp))
+
+    def _ap(self, recs, precs):
+        if self.ap_version == "integral":
+            ap, prev_r = 0.0, 0.0
+            for r, p in zip(recs, precs):
+                ap += (r - prev_r) * p
+                prev_r = r
+            return ap
+        ap = 0.0
+        for t in np.arange(0.0, 1.01, 0.1):
+            ps = [p for r, p in zip(recs, precs) if r >= t]
+            ap += (max(ps) if ps else 0.0) / 11.0
+        return ap
+
+    def eval(self, executor=None, scope=None):
+        aps = []
+        for c in range(self.num_classes):
+            if c == self.background or self._n_gt.get(c, 0) == 0:
+                continue
+            dets = sorted(self._dets.get(c, []), key=lambda d: -d[0])
+            tp_cum, recs, precs = 0, [], []
+            for i, (_, tp) in enumerate(dets):
+                tp_cum += int(tp)
+                recs.append(tp_cum / self._n_gt[c])
+                precs.append(tp_cum / (i + 1))
+            aps.append(self._ap(recs, precs) if dets else 0.0)
+        return float(np.mean(aps)) if aps else 0.0

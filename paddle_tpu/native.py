@@ -93,3 +93,38 @@ def arena_lib():
     lib.ptarena_peak.argtypes = [ctypes.c_void_p]
     lib.ptarena_destroy.argtypes = [ctypes.c_void_p]
     return lib
+
+
+def capi_lib():
+    """C inference API (native/capi.cc; reference capi/gradient_machine.h).
+    From Python/ctypes it joins the running interpreter; a standalone C
+    program links it with libpython and calls ptc_init(repo_path)."""
+    lib = load_lib("capi")
+    lib.ptc_init.restype = ctypes.c_int
+    lib.ptc_init.argtypes = [ctypes.c_char_p]
+    lib.ptc_model_load.restype = ctypes.c_void_p
+    lib.ptc_model_load.argtypes = [ctypes.c_char_p]
+    lib.ptc_model_forward.restype = ctypes.c_int
+    lib.ptc_model_num_outputs.restype = ctypes.c_int
+    lib.ptc_model_num_outputs.argtypes = [ctypes.c_void_p]
+    lib.ptc_model_output_name.restype = ctypes.c_char_p
+    lib.ptc_model_output_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptc_model_output_data.restype = ctypes.POINTER(ctypes.c_float)
+    lib.ptc_model_output_data.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                          ctypes.POINTER(ctypes.c_int64)]
+    lib.ptc_model_output_ndim.restype = ctypes.c_int
+    lib.ptc_model_output_ndim.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptc_model_output_dim.restype = ctypes.c_int64
+    lib.ptc_model_output_dim.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                         ctypes.c_int]
+    lib.ptc_model_release.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class PtcTensor(ctypes.Structure):
+    """Mirror of capi.cc's ptc_tensor."""
+    _fields_ = [("name", ctypes.c_char_p),
+                ("data", ctypes.c_void_p),
+                ("shape", ctypes.POINTER(ctypes.c_int64)),
+                ("ndim", ctypes.c_int),
+                ("dtype", ctypes.c_int)]

@@ -21,5 +21,6 @@ from . import (  # noqa: F401
     crf_ctc_ops,
     beam_search_ops,
     sparse_ops,
+    detection_ops,
     misc_ops,
 )

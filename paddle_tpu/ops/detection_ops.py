@@ -1,0 +1,279 @@
+"""SSD detection family.
+
+Parity with the reference detection stack (SURVEY A.1/A.2):
+``paddle/gserver/layers/PriorBox.cpp:95-150`` (anchor generation),
+``paddle/operators/math/detection_util.h:124-150`` (center-size box
+decode), ``paddle/operators/detection_output_op.{h,cc}`` (decode +
+per-class NMS + top-k), ``paddle/gserver/layers/MultiBoxLossLayer.cpp``
+(IoU matching, smooth-L1 loc loss, softmax conf loss with 3:1 hard
+negative mining). TPU-first: everything is static-shape — ground truth
+arrives padded ``(boxes[N,G,4], labels[N,G], count[N])``, NMS runs a
+bounded ``fori_loop`` over a fixed candidate set, and outputs are fixed
+``[N, keep_top_k, 6]`` with label -1 marking empty rows (the LoD-shaped
+output of the reference becomes count-prefixed rows).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _iou_matrix(a, b):
+    """IoU between a [P,4] and b [G,4] corner-format boxes -> [P,G]."""
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1],
+                                                       0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1],
+                                                       0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _center_size(boxes):
+    """corner [..,4] -> (cx, cy, w, h)."""
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    cx = boxes[..., 0] + w * 0.5
+    cy = boxes[..., 1] + h * 0.5
+    return cx, cy, w, h
+
+
+def _decode(loc, priors, variances):
+    """SSD center-size decode (detection_util.h:124-150)."""
+    pcx, pcy, pw, ph = _center_size(priors)
+    cx = variances[..., 0] * loc[..., 0] * pw + pcx
+    cy = variances[..., 1] * loc[..., 1] * ph + pcy
+    w = jnp.exp(variances[..., 2] * loc[..., 2]) * pw
+    h = jnp.exp(variances[..., 3] * loc[..., 3]) * ph
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+def _encode(gt, priors, variances):
+    """Inverse of _decode: regression targets for matched priors."""
+    pcx, pcy, pw, ph = _center_size(priors)
+    gcx, gcy, gw, gh = _center_size(gt)
+    eps = 1e-8
+    tx = (gcx - pcx) / jnp.maximum(pw, eps) / variances[..., 0]
+    ty = (gcy - pcy) / jnp.maximum(ph, eps) / variances[..., 1]
+    tw = jnp.log(jnp.maximum(gw, eps) /
+                 jnp.maximum(pw, eps)) / variances[..., 2]
+    th = jnp.log(jnp.maximum(gh, eps) /
+                 jnp.maximum(ph, eps)) / variances[..., 3]
+    return jnp.stack([tx, ty, tw, th], axis=-1)
+
+
+@register_op("prior_box")
+def _prior_box(ctx):
+    """SSD anchors for one feature map (PriorBox.cpp:95-150): per cell,
+    one box per min_size, sqrt(min*max) when max_sizes given, then
+    min*sqrt(ar) / min/sqrt(ar) per non-unit aspect ratio (with
+    reciprocals when flip)."""
+    feat = ctx.input("Input")          # [N, C, H, W]
+    img = ctx.input("Image")           # [N, 3, IH, IW]
+    min_sizes = [float(v) for v in ctx.attr("min_sizes")]
+    max_sizes = [float(v) for v in ctx.attr("max_sizes") or []]
+    ars_attr = [float(v) for v in ctx.attr("aspect_ratios") or []]
+    variances = [float(v) for v in ctx.attr("variances",
+                                            [0.1, 0.1, 0.2, 0.2])]
+    flip = ctx.attr("flip", True)
+    clip = ctx.attr("clip", True)
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_w = ctx.attr("step_w", 0.0) or iw / w
+    step_h = ctx.attr("step_h", 0.0) or ih / h
+    offset = ctx.attr("offset", 0.5)
+
+    ars = [1.0]
+    for ar in ars_attr:
+        if all(abs(ar - e) > 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    # per-cell (w, h) list, reference ordering: min, sqrt(min*max),
+    # then the non-unit aspect ratios of each min_size
+    whs = []
+    for i, ms in enumerate(min_sizes):
+        whs.append((ms, ms))
+        if max_sizes:
+            s = math.sqrt(ms * max_sizes[i])
+            whs.append((s, s))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+    num_priors = len(whs)
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w  # [W]
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h  # [H]
+    cx = jnp.broadcast_to(cx[None, :, None], (h, w, num_priors))
+    cy = jnp.broadcast_to(cy[:, None, None], (h, w, num_priors))
+    bw = jnp.asarray([p[0] for p in whs], jnp.float32) / 2.0
+    bh = jnp.asarray([p[1] for p in whs], jnp.float32) / 2.0
+    boxes = jnp.stack([(cx - bw) / iw, (cy - bh) / ih,
+                       (cx + bw) / iw, (cy + bh) / ih], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           boxes.shape)
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("box_coder")
+def _box_coder(ctx):
+    """Encode/decode center-size box regression (reference box coding in
+    detection_util.h; attr code_type: 'decode_center_size' |
+    'encode_center_size')."""
+    priors = ctx.input("PriorBox").reshape(-1, 4)
+    pvar = ctx.input("PriorBoxVar").reshape(-1, 4)
+    t = ctx.input("TargetBox")
+    if ctx.attr("code_type", "decode_center_size") == \
+            "decode_center_size":
+        return {"OutputBox": _decode(t, priors, pvar)}
+    return {"OutputBox": _encode(t, priors, pvar)}
+
+
+def _match(iou, valid_g, overlap_threshold):
+    """SSD bipartite + per-prediction matching (MultiBoxLossLayer
+    matchBBox): per-GT best prior is force-matched; other priors match
+    their best GT if IoU > threshold. Returns [P] gt index or -1."""
+    p, g = iou.shape
+    iou = jnp.where(valid_g[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)                  # [P]
+    best_gt_iou = jnp.max(iou, axis=1)
+    match = jnp.where(best_gt_iou > overlap_threshold, best_gt, -1)
+    # force-match each valid GT's best prior; padding GTs scatter to an
+    # out-of-range slot (mode='drop') so they can never overwrite a
+    # valid GT's forced prior
+    best_prior = jnp.argmax(iou, axis=0)               # [G]
+    gt_ids = jnp.arange(g, dtype=jnp.int32)
+    tgt = jnp.where(valid_g, best_prior, p).astype(jnp.int32)
+    forced = jnp.full((p,), -1, jnp.int32).at[tgt].set(gt_ids,
+                                                       mode="drop")
+    return jnp.where(forced >= 0, forced, match).astype(jnp.int32)
+
+
+@register_op("multibox_loss")
+def _multibox_loss(ctx):
+    """SSD loss (MultiBoxLossLayer.cpp): smooth-L1 on matched priors +
+    softmax CE with hard negative mining at neg_pos_ratio."""
+    loc = ctx.input("Loc")        # [N, P, 4]
+    conf = ctx.input("Conf")      # [N, P, C] logits
+    priors = ctx.input("PriorBox").reshape(-1, 4)
+    pvar = ctx.input("PriorBoxVar").reshape(-1, 4)
+    gt_box = ctx.input("GtBox")   # [N, G, 4]
+    gt_label = ctx.input("GtLabel").reshape(gt_box.shape[0], -1)  # [N,G]
+    gt_count = ctx.input("GtCount").reshape(-1)                   # [N]
+    overlap_t = ctx.attr("overlap_threshold", 0.5)
+    neg_ratio = ctx.attr("neg_pos_ratio", 3.0)
+    background = ctx.attr("background_label", 0)
+    g = gt_box.shape[1]
+
+    def one(loc_i, conf_i, gt_b, gt_l, cnt):
+        valid_g = jnp.arange(g) < cnt
+        iou = _iou_matrix(priors, gt_b)  # match PRIORS to GT
+        m = _match(iou, valid_g, overlap_t)            # [P]
+        pos = m >= 0
+        n_pos = jnp.sum(pos)
+        safe_m = jnp.maximum(m, 0)
+        # localization: smooth L1 vs encoded matched GT
+        tgt = _encode(gt_b[safe_m], priors, pvar)
+        diff = loc_i - tgt
+        a = jnp.abs(diff)
+        sl1 = jnp.where(a < 1.0, 0.5 * a * a, a - 0.5).sum(-1)
+        loc_loss = jnp.sum(sl1 * pos)
+        # confidence: CE against matched label / background
+        cls = jnp.where(pos, gt_l[safe_m].astype(jnp.int32),
+                        background)
+        logp = jax.nn.log_softmax(conf_i, axis=-1)
+        ce = -jnp.take_along_axis(logp, cls[:, None], axis=1)[:, 0]
+        # hard negative mining: top-k negatives by loss
+        n_neg = jnp.minimum((neg_ratio * n_pos).astype(jnp.int32),
+                            jnp.sum(~pos)).astype(jnp.int32)
+        neg_ce = jnp.where(pos, -jnp.inf, ce)
+        order = jnp.argsort(-neg_ce)
+        rank = jnp.zeros_like(order).at[order].set(
+            jnp.arange(order.shape[0]))
+        neg_sel = (~pos) & (rank < n_neg)
+        conf_loss = jnp.sum(ce * (pos | neg_sel))
+        denom = jnp.maximum(n_pos, 1).astype(loc_i.dtype)
+        return loc_loss / denom, conf_loss / denom
+
+    loc_l, conf_l = jax.vmap(one)(loc, conf, gt_box, gt_label, gt_count)
+    loss = jnp.mean(loc_l + conf_l)
+    return {"Loss": loss.reshape(1),
+            "LocLoss": jnp.mean(loc_l).reshape(1),
+            "ConfLoss": jnp.mean(conf_l).reshape(1)}
+
+
+def _nms_mask(boxes, scores, valid, nms_threshold, max_keep):
+    """Greedy NMS over a fixed candidate set via bounded fori_loop.
+    Returns keep mask [K]."""
+    k = boxes.shape[0]
+    iou = _iou_matrix(boxes, boxes)
+    order = jnp.argsort(-scores)
+
+    def body(i, state):
+        keep, banned = state
+        idx = order[i]
+        ok = valid[idx] & ~banned[idx]
+        keep = keep.at[idx].set(ok)
+        banned = banned | (ok & (iou[idx] > nms_threshold))
+        return keep, banned
+
+    keep, _ = jax.lax.fori_loop(
+        0, k, body, (jnp.zeros(k, bool), jnp.zeros(k, bool)))
+    return keep
+
+
+@register_op("detection_output")
+def _detection_output(ctx):
+    """Decode + per-class NMS + cross-class top-k
+    (detection_output_op.h): output [N, keep_top_k, 6] rows of
+    (label, score, xmin, ymin, xmax, ymax), label -1 = empty."""
+    loc = ctx.input("Loc")        # [N, P, 4]
+    scores = ctx.input("Scores")  # [N, P, C] probabilities
+    priors = ctx.input("PriorBox").reshape(-1, 4)
+    pvar = ctx.input("PriorBoxVar").reshape(-1, 4)
+    background = ctx.attr("background_label", 0)
+    score_t = ctx.attr("confidence_threshold", 0.01)
+    nms_t = ctx.attr("nms_threshold", 0.45)
+    nms_top_k = int(ctx.attr("nms_top_k", 64))
+    keep_top_k = int(ctx.attr("keep_top_k", 16))
+    n_cls = scores.shape[-1]
+
+    def one(loc_i, sc_i):
+        boxes = _decode(loc_i, priors, pvar)           # [P, 4]
+        outs = []
+        for c in range(n_cls):
+            if c == background:
+                continue
+            s = sc_i[:, c]
+            k = min(nms_top_k, s.shape[0])
+            top_s, top_idx = jax.lax.top_k(s, k)
+            cand = boxes[top_idx]
+            valid = top_s > score_t
+            keep = _nms_mask(cand, top_s, valid, nms_t, k)
+            sel_s = jnp.where(keep, top_s, -1.0)
+            outs.append((jnp.full((k,), c, jnp.float32), sel_s, cand))
+        labels = jnp.concatenate([o[0] for o in outs])
+        sc = jnp.concatenate([o[1] for o in outs])
+        bx = jnp.concatenate([o[2] for o in outs], axis=0)
+        kk = min(keep_top_k, sc.shape[0])
+        fs, fi = jax.lax.top_k(sc, kk)
+        rows = jnp.concatenate(
+            [jnp.where(fs > score_t, labels[fi], -1.0)[:, None],
+             fs[:, None], bx[fi]], axis=1)
+        if kk < keep_top_k:
+            pad = jnp.full((keep_top_k - kk, 6), -1.0, rows.dtype)
+            rows = jnp.concatenate([rows, pad], axis=0)
+        return rows
+
+    return {"Out": jax.vmap(one)(loc, scores)}

@@ -405,7 +405,8 @@ class ModelAverage:
     def _swap_in(self, scope=None):
         from .core.scope import global_scope
         scope = scope or global_scope()
-        count = float(np.asarray(scope.find_var(self._count_name)))
+        count = float(np.asarray(scope.find_var(
+            self._count_name)).ravel()[0])
         if count <= 0:
             raise RuntimeError("ModelAverage.apply before any step ran")
         self._backup = {}

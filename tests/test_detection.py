@@ -1,0 +1,232 @@
+"""SSD detection family (ops/detection_ops.py, layers/detection.py,
+evaluator.DetectionMAP; reference PriorBox.cpp, MultiBoxLossLayer.cpp,
+detection_output_op.h, DetectionMAPEvaluator.cpp)."""
+
+import numpy as np
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+from paddle_tpu.evaluator import DetectionMAP
+
+
+def _run(build):
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        fetches, feed = build()
+    exe = ptpu.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetches)
+
+
+class TestPriorBox:
+    def test_reference_anchor_math(self):
+        """2x2 feature map over a 100x100 image: first prior = min_size
+        square at the cell center; with max_size, second =
+        sqrt(min*max) square (PriorBox.cpp:104-131)."""
+        def build():
+            feat = layers.data("feat", shape=[1, 8, 2, 2],
+                               append_batch_size=False)
+            img = layers.data("img", shape=[1, 3, 100, 100],
+                              append_batch_size=False)
+            boxes, var = layers.prior_box(
+                feat, img, min_sizes=[20.0], max_sizes=[45.0],
+                aspect_ratios=[2.0], clip=False)
+            return [boxes, var], {
+                "feat": np.zeros((1, 8, 2, 2), "float32"),
+                "img": np.zeros((1, 3, 100, 100), "float32")}
+
+        boxes, var = _run(build)
+        # 1 min + 1 max + 2 flipped ratios = 4 priors
+        assert boxes.shape == (2, 2, 4, 4)
+        # cell (0,0): center (25, 25); min prior 20x20 -> [15,15,35,35]/100
+        np.testing.assert_allclose(boxes[0, 0, 0],
+                                   [0.15, 0.15, 0.35, 0.35], atol=1e-6)
+        s = np.sqrt(20.0 * 45.0) / 2
+        np.testing.assert_allclose(
+            boxes[0, 0, 1],
+            [(25 - s) / 100, (25 - s) / 100, (25 + s) / 100,
+             (25 + s) / 100], atol=1e-6)
+        # ar=2: w = 20*sqrt(2), h = 20/sqrt(2)
+        w, h = 10 * np.sqrt(2), 10 / np.sqrt(2)
+        np.testing.assert_allclose(
+            boxes[0, 0, 2],
+            [(25 - w) / 100, (25 - h) / 100, (25 + w) / 100,
+             (25 + h) / 100], atol=1e-6)
+        np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+class TestBoxCoder:
+    def test_encode_decode_round_trip(self):
+        rs = np.random.RandomState(0)
+        priors = np.array([[0.1, 0.1, 0.5, 0.5], [0.3, 0.2, 0.9, 0.8]],
+                          dtype="float32")
+        pvar = np.full((2, 4), 0.1, dtype="float32")
+        gt = np.array([[0.15, 0.12, 0.55, 0.60],
+                       [0.25, 0.25, 0.85, 0.75]], dtype="float32")
+
+        def build():
+            pb = layers.data("pb", shape=[2, 4], append_batch_size=False)
+            pv = layers.data("pv", shape=[2, 4], append_batch_size=False)
+            tb = layers.data("tb", shape=[2, 4], append_batch_size=False)
+            enc = layers.box_coder(pv, pb, tb, "encode_center_size")
+            dec = layers.box_coder(pv, pb, enc, "decode_center_size")
+            return [dec], {"pb": priors, "pv": pvar, "tb": gt}
+
+        dec, = _run(build)
+        np.testing.assert_allclose(dec, gt, rtol=1e-4, atol=1e-5)
+
+
+class TestMultiboxLoss:
+    def _loss(self, loc_v, conf_v):
+        priors = np.array([[0.0, 0.0, 0.4, 0.4], [0.5, 0.5, 1.0, 1.0],
+                           [0.0, 0.6, 0.3, 1.0]], dtype="float32")
+        pvar = np.full((3, 4), 0.1, dtype="float32")
+        gt_b = np.array([[[0.05, 0.05, 0.35, 0.35]]], dtype="float32")
+        gt_l = np.array([[1]], dtype="int64")
+        cnt = np.array([1], dtype="int64")
+
+        def build():
+            loc = layers.data("loc", shape=[1, 3, 4],
+                              append_batch_size=False)
+            conf = layers.data("conf", shape=[1, 3, 2],
+                               append_batch_size=False)
+            pb = layers.data("pb", shape=[3, 4], append_batch_size=False)
+            pv = layers.data("pv", shape=[3, 4], append_batch_size=False)
+            gb = layers.data("gb", shape=[1, 1, 4],
+                             append_batch_size=False)
+            gl = layers.data("gl", shape=[1, 1], dtype="int64",
+                             append_batch_size=False)
+            gc = layers.data("gc", shape=[1], dtype="int64",
+                             append_batch_size=False)
+            loss, ll, cl = layers.multibox_loss(loc, conf, pb, pv, gb,
+                                                gl, gc)
+            return [loss, ll, cl], {"loc": loc_v, "conf": conf_v,
+                                    "pb": priors, "pv": pvar,
+                                    "gb": gt_b, "gl": gt_l, "gc": cnt}
+
+        return _run(build)
+
+    def test_perfect_prediction_small_loss(self):
+        """loc that exactly encodes the GT + confident correct class
+        scores ~zero loss; a wrong prediction scores higher."""
+        # encode GT against prior 0 by hand (var 0.1)
+        pcx, pcy, pw, ph = 0.2, 0.2, 0.4, 0.4
+        gcx, gcy, gw, gh = 0.2, 0.2, 0.3, 0.3
+        t = [(gcx - pcx) / pw / 0.1, (gcy - pcy) / ph / 0.1,
+             np.log(gw / pw) / 0.1, np.log(gh / ph) / 0.1]
+        loc_good = np.zeros((1, 3, 4), "float32")
+        loc_good[0, 0] = t
+        conf_good = np.zeros((1, 3, 2), "float32")
+        conf_good[0, 0] = [-8, 8]     # matched prior: class 1
+        conf_good[0, 1] = [8, -8]     # negatives: background
+        conf_good[0, 2] = [8, -8]
+        loss_g, ll_g, cl_g = self._loss(loc_good, conf_good)
+        assert ll_g[0] < 1e-4
+        assert cl_g[0] < 1e-3
+
+        loc_bad = np.zeros((1, 3, 4), "float32")  # no offset correction
+        conf_bad = np.zeros((1, 3, 2), "float32")  # uniform logits
+        loss_b, ll_b, cl_b = self._loss(loc_bad, conf_bad)
+        assert loss_b[0] > loss_g[0] + 0.1
+
+    def test_trains_a_head(self):
+        """A tiny predictor head learns to localize + classify."""
+        rs = np.random.RandomState(0)
+        priors = np.array([[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0]],
+                          dtype="float32")
+        pvar = np.full((2, 4), 0.1, dtype="float32")
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            gb = layers.data("gb", shape=[1, 4])
+            gl = layers.data("gl", shape=[1], dtype="int64")
+            gc = layers.data("gc", shape=[], dtype="int64")
+            pb = layers.data("pb", shape=[2, 4],
+                             append_batch_size=False)
+            pv = layers.data("pv", shape=[2, 4],
+                             append_batch_size=False)
+            h = layers.fc(x, 16, act="relu")
+            loc = layers.reshape(layers.fc(h, 8), [-1, 2, 4])
+            conf = layers.reshape(layers.fc(h, 4), [-1, 2, 2])
+            loss, _, _ = layers.multibox_loss(loc, conf, pb, pv, gb,
+                                              gl, gc)
+            ptpu.optimizer.Adam(learning_rate=2e-2).minimize(
+                loss, startup_program=startup)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(250):
+            n = 8
+            which = rs.randint(0, 2, n)
+            # deterministic offset per prior so the loss floor is ~0
+            off = np.array([0.02, -0.02, 0.03, 0.01], "float32")
+            gt = np.stack([priors[w] + off * (1 + w)
+                           for w in which]).astype("float32")
+            feed = {"x": np.eye(4, dtype="float32")[which * 2],
+                    "gb": gt[:, None, :],
+                    "gl": np.ones((n, 1), "int64"),
+                    "gc": np.ones((n,), "int64"),
+                    "pb": priors, "pv": pvar}
+            out, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(out).ravel()[0]))
+        assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+        assert np.isfinite(losses).all()
+
+
+class TestDetectionOutput:
+    def test_nms_keeps_best_and_suppresses_overlaps(self):
+        priors = np.array([[0.1, 0.1, 0.4, 0.4],
+                           [0.12, 0.12, 0.42, 0.42],
+                           [0.6, 0.6, 0.9, 0.9]], dtype="float32")
+        pvar = np.full((3, 4), 0.1, dtype="float32")
+        loc = np.zeros((1, 3, 4), "float32")  # decoded == priors
+        scores = np.array([[[0.1, 0.9], [0.2, 0.8], [0.3, 0.7]]],
+                          dtype="float32")
+
+        def build():
+            lo = layers.data("lo", shape=[1, 3, 4],
+                             append_batch_size=False)
+            sc = layers.data("sc", shape=[1, 3, 2],
+                             append_batch_size=False)
+            pb = layers.data("pb", shape=[3, 4],
+                             append_batch_size=False)
+            pv = layers.data("pv", shape=[3, 4],
+                             append_batch_size=False)
+            out = layers.detection_output(lo, sc, pb, pv,
+                                          nms_threshold=0.5,
+                                          confidence_threshold=0.3,
+                                          keep_top_k=4)
+            return [out], {"lo": loc, "sc": scores, "pb": priors,
+                           "pv": pvar}
+
+        out, = _run(build)
+        rows = out[0]
+        kept = rows[rows[:, 0] >= 0]
+        # priors 0/1 overlap heavily: only the higher-scored (0.9)
+        # survives; prior 2 (0.7) is separate and kept
+        assert kept.shape[0] == 2
+        np.testing.assert_allclose(sorted(kept[:, 1], reverse=True),
+                                   [0.9, 0.7], atol=1e-6)
+        best = kept[np.argmax(kept[:, 1])]
+        np.testing.assert_allclose(best[2:6], priors[0], atol=1e-5)
+
+
+class TestDetectionMAP:
+    def test_perfect_and_missed(self):
+        m = DetectionMAP(num_classes=3)
+        gt_boxes = np.array([[[0.1, 0.1, 0.4, 0.4],
+                              [0.6, 0.6, 0.9, 0.9]]], "float32")
+        gt_labels = np.array([[1, 2]], "int64")
+        counts = np.array([2], "int64")
+        dets = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                          [2, 0.8, 0.6, 0.6, 0.9, 0.9],
+                          [-1, -1, 0, 0, 0, 0]]], "float32")
+        m.update(dets, gt_boxes, gt_labels, counts)
+        assert abs(m.eval() - 1.0) < 1e-6
+
+        m.reset()
+        dets_bad = np.array([[[1, 0.9, 0.5, 0.5, 0.7, 0.7],  # misplaced
+                              [2, 0.8, 0.6, 0.6, 0.9, 0.9],
+                              [-1, -1, 0, 0, 0, 0]]], "float32")
+        m.update(dets_bad, gt_boxes, gt_labels, counts)
+        assert m.eval() < 0.6  # class 1 AP 0, class 2 AP 1

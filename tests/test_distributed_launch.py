@@ -1,0 +1,62 @@
+"""2-process jax.distributed smoke test over localhost
+(distributed/launch.py; the reference's analog is
+tests/book_distribute/notest_recognize_digits_mlp_dist.py:53-58 —
+a pserver + trainer pair on localhost).
+
+Spawns two REAL processes, each with 2 virtual CPU devices; they form
+one 4-device global mesh and run a data-parallel train step whose
+mean-loss all-reduce crosses the process boundary. Skips (not fails)
+where subprocess spawning or the coordinator port is unavailable."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_global_mesh_all_reduce():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "launch_worker.py")
+    port = _free_port()
+    env = dict(os.environ)
+    # must be set BEFORE interpreter start: the environment's
+    # sitecustomize pre-registers an accelerator plugin otherwise
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    procs = []
+    try:
+        for pid in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, worker, repo, str(port), str(pid), "2"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                env=env, text=True))
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                pytest.skip("distributed workers timed out "
+                            "(coordinator blocked in this env)")
+            outs.append(out)
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, "worker %d failed:\n%s" % (pid, out)
+            assert "WORKER_OK %d" % pid in out, out
+        # both processes computed the SAME replicated global loss
+        l0 = [ln for ln in outs[0].splitlines() if "WORKER_OK" in ln][0]
+        l1 = [ln for ln in outs[1].splitlines() if "WORKER_OK" in ln][0]
+        assert l0.split("loss=")[1] == l1.split("loss=")[1]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
