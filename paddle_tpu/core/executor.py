@@ -38,14 +38,15 @@ def _lookup(env, name, op, block):
     try:
         return env[name]
     except KeyError:
+        from .enforce import EnforceNotMet
         reader = op.type if op is not None else "<fetch>"
         var = block.var_or_none(name)
         if var is not None and var.persistable:
-            raise RuntimeError(
+            raise EnforceNotMet(
                 "persistable variable %r read by %r is not initialized in "
                 "scope — run the startup program first" % (name, reader))
-        raise RuntimeError("%r reads undefined variable %r"
-                           % (reader, name)) from None
+        raise EnforceNotMet("%r reads undefined variable %r"
+                            % (reader, name)) from None
 
 
 # Mixed-precision op lists (config flag "amp"). WHITE ops are the MXU

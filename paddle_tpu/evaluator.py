@@ -14,7 +14,8 @@ from .core.scope import global_scope
 from .layer_helper import LayerHelper
 from .initializer import ConstantInitializer
 
-__all__ = ["Evaluator", "Accuracy", "ChunkEvaluator", "DetectionMAP"]
+__all__ = ["Evaluator", "Accuracy", "ChunkEvaluator", "DetectionMAP",
+           "Auc", "PrecisionRecall", "PnPair", "EditDistanceEvaluator"]
 
 
 class Evaluator:
@@ -223,3 +224,166 @@ class DetectionMAP:
                 precs.append(tp_cum / (i + 1))
             aps.append(self._ap(recs, precs) if dets else 0.0)
         return float(np.mean(aps)) if aps else 0.0
+
+
+class Auc(Evaluator):
+    """Accumulated ROC-AUC (reference auc_op.cc accumulation +
+    gserver rankauc evaluator capability): per-threshold TP/FP/FN/TN
+    counts accumulate across batches; eval() integrates the ROC."""
+
+    def __init__(self, input, label, num_thresholds=200, **kwargs):
+        super().__init__("auc_evaluator", **kwargs)
+        self.num_thresholds = num_thresholds
+        self._counts = self._create_state("counts",
+                                          [num_thresholds, 4], "float32")
+        helper = self.helper
+        auc_out = helper.create_tmp_variable("float32",
+                                             stop_gradient=True)
+        counts = helper.create_tmp_variable("float32", stop_gradient=True)
+        helper.append_op(type="auc",
+                         inputs={"Out": [input.name],
+                                 "Label": [label.name]},
+                         outputs={"AUC": [auc_out.name],
+                                  "StatCounts": [counts.name]},
+                         attrs={"num_thresholds": num_thresholds})
+        helper.append_op(type="sum",
+                         inputs={"X": [self._counts.name, counts.name]},
+                         outputs={"Out": [self._counts.name]},
+                         infer_shape=False)
+        self.metric = auc_out
+
+    def eval(self, executor=None, scope=None):
+        scope = scope or global_scope()
+        c = np.asarray(scope.find_var(self._counts.name))
+        tp, fp, fn, tn = c[:, 0], c[:, 1], c[:, 2], c[:, 3]
+        tpr = tp / np.maximum(tp + fn, 1e-12)
+        fpr = fp / np.maximum(fp + tn, 1e-12)
+        return float(abs(np.sum((fpr[:-1] - fpr[1:]) *
+                                (tpr[:-1] + tpr[1:]) / 2.0)))
+
+
+class PrecisionRecall(Evaluator):
+    """Accumulated per-class precision/recall/F1 (reference
+    precision_recall_op.cc states + gserver precision_recall
+    evaluator). eval() returns 6 numbers: macro then micro (p, r, f1)."""
+
+    def __init__(self, input, label, num_classes, **kwargs):
+        super().__init__("precision_recall_evaluator", **kwargs)
+        self.num_classes = num_classes
+        self._states = self._create_state("tp_fp_fn", [num_classes, 3],
+                                          "float32")
+        helper = self.helper
+        topk_out = helper.create_tmp_variable(input.dtype,
+                                              stop_gradient=True)
+        topk_idx = helper.create_tmp_variable("int64", stop_gradient=True)
+        helper.append_op(type="top_k", inputs={"X": [input.name]},
+                         outputs={"Out": [topk_out.name],
+                                  "Indices": [topk_idx.name]},
+                         attrs={"k": 1})
+        batch = helper.create_tmp_variable("float32", stop_gradient=True)
+        accum = helper.create_tmp_variable("float32", stop_gradient=True)
+        states = helper.create_tmp_variable("float32", stop_gradient=True)
+        helper.append_op(type="precision_recall",
+                         inputs={"MaxProbs": [topk_out.name],
+                                 "Indices": [topk_idx.name],
+                                 "Labels": [label.name]},
+                         outputs={"BatchMetrics": [batch.name],
+                                  "AccumMetrics": [accum.name],
+                                  "AccumStatesInfo": [states.name]},
+                         attrs={"class_number": num_classes})
+        helper.append_op(type="sum",
+                         inputs={"X": [self._states.name, states.name]},
+                         outputs={"Out": [self._states.name]},
+                         infer_shape=False)
+        self.metric = batch
+
+    def eval(self, executor=None, scope=None):
+        scope = scope or global_scope()
+        s = np.asarray(scope.find_var(self._states.name))
+        tp, fp, fn = s[:, 0], s[:, 1], s[:, 2]
+        p = tp / np.maximum(tp + fp, 1e-12)
+        r = tp / np.maximum(tp + fn, 1e-12)
+        f1 = 2 * p * r / np.maximum(p + r, 1e-12)
+        mi_p = tp.sum() / max(float((tp + fp).sum()), 1e-12)
+        mi_r = tp.sum() / max(float((tp + fn).sum()), 1e-12)
+        mi_f = 2 * mi_p * mi_r / max(mi_p + mi_r, 1e-12)
+        return (float(p.mean()), float(r.mean()), float(f1.mean()),
+                float(mi_p), float(mi_r), float(mi_f))
+
+
+class PnPair(Evaluator):
+    """Accumulated positive-negative pair ranking ratio (reference
+    positive_negative_pair_op / gserver pnpair evaluator)."""
+
+    def __init__(self, score, label, query_id, **kwargs):
+        super().__init__("pnpair_evaluator", **kwargs)
+        self._pos = self._create_state("pos", [], "float32")
+        self._neg = self._create_state("neg", [], "float32")
+        helper = self.helper
+        pos = helper.create_tmp_variable("float32", stop_gradient=True)
+        neg = helper.create_tmp_variable("float32", stop_gradient=True)
+        neu = helper.create_tmp_variable("float32", stop_gradient=True)
+        helper.append_op(type="positive_negative_pair",
+                         inputs={"Score": [score.name],
+                                 "Label": [label.name],
+                                 "QueryID": [query_id.name]},
+                         outputs={"PositivePair": [pos.name],
+                                  "NegativePair": [neg.name],
+                                  "NeutralPair": [neu.name]})
+        for state, batch in ((self._pos, pos), (self._neg, neg)):
+            helper.append_op(type="sum",
+                             inputs={"X": [state.name, batch.name]},
+                             outputs={"Out": [state.name]},
+                             infer_shape=False)
+
+    def eval(self, executor=None, scope=None):
+        scope = scope or global_scope()
+        pos = float(np.asarray(scope.find_var(self._pos.name)))
+        neg = float(np.asarray(scope.find_var(self._neg.name)))
+        return pos / max(neg, 1e-12)
+
+
+class EditDistanceEvaluator(Evaluator):
+    """Accumulated mean edit distance (reference ctc_error evaluator /
+    edit_distance_op accumulation)."""
+
+    def __init__(self, hyps, hyps_length, refs, refs_length,
+                 normalized=False, **kwargs):
+        super().__init__("edit_distance_evaluator", **kwargs)
+        self._total = self._create_state("total", [], "float32")
+        self._count = self._create_state("count", [], "float32")
+        helper = self.helper
+        dist = helper.create_tmp_variable("float32", stop_gradient=True)
+        seq_num = helper.create_tmp_variable("float32",
+                                             stop_gradient=True)
+        helper.append_op(type="edit_distance",
+                         inputs={"Hyps": [hyps.name],
+                                 "HypsLength": [hyps_length.name],
+                                 "Refs": [refs.name],
+                                 "RefsLength": [refs_length.name]},
+                         outputs={"Out": [dist.name],
+                                  "SequenceNum": [seq_num.name]},
+                         attrs={"normalized": normalized})
+        summed = helper.create_tmp_variable("float32",
+                                            stop_gradient=True)
+        cnt = helper.create_tmp_variable("float32", stop_gradient=True)
+        helper.append_op(type="reduce_sum", inputs={"X": [dist.name]},
+                         outputs={"Out": [summed.name]},
+                         attrs={"dim": None, "keep_dim": False,
+                                "reduce_all": True})
+        helper.append_op(type="cast", inputs={"X": [seq_num.name]},
+                         outputs={"Out": [cnt.name]},
+                         attrs={"out_dtype": "float32"})
+        for state, batch in ((self._total, summed),
+                             (self._count, cnt)):
+            helper.append_op(type="sum",
+                             inputs={"X": [state.name, batch.name]},
+                             outputs={"Out": [state.name]},
+                             infer_shape=False)
+        self.metric = dist
+
+    def eval(self, executor=None, scope=None):
+        scope = scope or global_scope()
+        total = float(np.asarray(scope.find_var(self._total.name)))
+        n_seq = float(np.asarray(scope.find_var(self._count.name)))
+        return total / max(n_seq, 1.0)

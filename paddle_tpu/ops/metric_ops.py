@@ -44,7 +44,10 @@ def _auc(ctx):
     fpr = fp / jnp.maximum(fp + tn, 1e-12)
     # trapezoid over decreasing thresholds
     auc_val = jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0)
-    return {"AUC": jnp.abs(auc_val)}
+    # per-threshold counts [T,4] for the stateful Auc evaluator
+    # (reference auc_op accumulates _stat_pos/_stat_neg across batches)
+    return {"AUC": jnp.abs(auc_val),
+            "StatCounts": jnp.stack([tp, fp, fn, tn], axis=1)}
 
 
 @register_op("precision_recall")

@@ -1,0 +1,41 @@
+"""Leveled logging — the reference's glog-style logging discipline
+(``paddle/utils/Logging.h`` LOG(INFO/WARNING/ERROR/FATAL) + VLOG(n)),
+on Python's logging with env-controlled verbosity:
+
+* ``PADDLE_TPU_LOG_LEVEL`` — standard level name (default WARNING)
+* ``PADDLE_TPU_VLOG``     — integer VLOG verbosity (default 0)
+"""
+
+import logging
+import os
+
+__all__ = ["logger", "vlog", "set_level"]
+
+_LOGGER = None
+
+
+def logger():
+    global _LOGGER
+    if _LOGGER is None:
+        lg = logging.getLogger("paddle_tpu")
+        if not lg.handlers:
+            h = logging.StreamHandler()
+            h.setFormatter(logging.Formatter(
+                "%(levelname).1s %(asctime)s %(name)s] %(message)s",
+                "%m%d %H:%M:%S"))
+            lg.addHandler(h)
+            lg.propagate = False
+        lg.setLevel(os.environ.get("PADDLE_TPU_LOG_LEVEL",
+                                   "WARNING").upper())
+        _LOGGER = lg
+    return _LOGGER
+
+
+def set_level(level):
+    logger().setLevel(level.upper() if isinstance(level, str) else level)
+
+
+def vlog(n, msg, *args):
+    """VLOG(n): emitted at INFO when PADDLE_TPU_VLOG >= n."""
+    if int(os.environ.get("PADDLE_TPU_VLOG", "0")) >= n:
+        logger().info(msg, *args)

@@ -1,0 +1,103 @@
+"""Stateful evaluator breadth (reference gserver evaluators
+Evaluator.cpp:40-1357: rankauc, precision_recall, pnpair, ctc_error as
+accumulating evaluators; printers are layers.Print)."""
+
+import numpy as np
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+from paddle_tpu.evaluator import (Auc, PrecisionRecall, PnPair,
+                                  EditDistanceEvaluator)
+
+
+def test_auc_accumulates_across_batches():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        score = layers.data("score", shape=[1])
+        label = layers.data("label", shape=[1], dtype="int64")
+        ev = Auc(score, label, num_thresholds=200)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    all_s, all_l = [], []
+    for _ in range(4):
+        lv = rs.randint(0, 2, (32, 1))
+        # separable-ish scores -> high AUC
+        sv = (lv * 0.6 + rs.rand(32, 1) * 0.4).astype("float32")
+        exe.run(main, feed={"score": sv, "label": lv.astype("int64")},
+                fetch_list=[ev.metric])
+        all_s.append(sv); all_l.append(lv)
+    auc = ev.eval()
+    # sanity reference: threshold-sweep AUC over the pooled stream
+    s = np.concatenate(all_s).ravel(); l = np.concatenate(all_l).ravel()
+    ths = np.linspace(0, 1, 200)
+    tp = ((s[None] > ths[:, None]) & (l[None] > 0)).sum(1)
+    fp = ((s[None] > ths[:, None]) & (l[None] == 0)).sum(1)
+    fn = ((s[None] <= ths[:, None]) & (l[None] > 0)).sum(1)
+    tn = ((s[None] <= ths[:, None]) & (l[None] == 0)).sum(1)
+    tpr = tp / np.maximum(tp + fn, 1e-12)
+    fpr = fp / np.maximum(fp + tn, 1e-12)
+    want = abs(np.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2))
+    assert abs(auc - want) < 1e-5
+    assert auc > 0.7
+
+
+def test_precision_recall_accumulates():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        probs = layers.data("probs", shape=[3])
+        label = layers.data("label", shape=[1], dtype="int64")
+        ev = PrecisionRecall(probs, label, num_classes=3)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(1)
+    preds, labs = [], []
+    for _ in range(3):
+        lv = rs.randint(0, 3, (16, 1)).astype("int64")
+        pv = rs.rand(16, 3).astype("float32")
+        pv[np.arange(16), lv.ravel()] += (rs.rand(16) > 0.3) * 2.0
+        exe.run(main, feed={"probs": pv, "label": lv},
+                fetch_list=[ev.metric])
+        preds.append(pv.argmax(1)); labs.append(lv.ravel())
+    p_mac, r_mac, f_mac, p_mi, r_mi, f_mi = ev.eval()
+    pred = np.concatenate(preds); lab = np.concatenate(labs)
+    # micro precision == overall accuracy for single-label classification
+    assert abs(p_mi - (pred == lab).mean()) < 1e-6
+    assert 0.0 <= f_mac <= 1.0
+
+
+def test_pnpair_accumulates():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        score = layers.data("score", shape=[1])
+        label = layers.data("label", shape=[1], dtype="int64")
+        qid = layers.data("qid", shape=[1], dtype="int64")
+        ev = PnPair(score, label, qid)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    # one query: labels [2,1,0], perfectly-ordered scores
+    feed = {"score": np.array([[0.9], [0.5], [0.1]], "float32"),
+            "label": np.array([[2], [1], [0]], "int64"),
+            "qid": np.array([[7], [7], [7]], "int64")}
+    exe.run(main, feed=feed, fetch_list=[])
+    ratio = ev.eval()
+    assert ratio > 100  # all pairs positive -> pos/neg ~ 1/eps
+
+
+def test_edit_distance_evaluator_mean():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        hyp = layers.data("hyp", shape=[4], dtype="int64")
+        hlen = layers.data("hlen", shape=[], dtype="int64")
+        ref = layers.data("ref", shape=[4], dtype="int64")
+        rlen = layers.data("rlen", shape=[], dtype="int64")
+        ev = EditDistanceEvaluator(hyp, hlen, ref, rlen)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    feed = {"hyp": np.array([[1, 2, 3, 0], [1, 2, 3, 4]], "int64"),
+            "hlen": np.array([3, 4], "int64"),
+            "ref": np.array([[1, 2, 3, 0], [9, 9, 9, 9]], "int64"),
+            "rlen": np.array([3, 4], "int64")}
+    exe.run(main, feed=feed, fetch_list=[])
+    # distances: 0 and 4 -> mean 2.0
+    assert abs(ev.eval() - 2.0) < 1e-6

@@ -1,0 +1,96 @@
+"""Platform layer: enforce discipline (reference platform/enforce.h),
+leveled logging (utils/Logging.h analog), v2 image transforms
+(v2/image.py), Ploter (v2/plot)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as ptpu
+from paddle_tpu.core.enforce import (EnforceNotMet, enforce, enforce_eq,
+                                     enforce_not_none)
+from paddle_tpu.utils import image as pimage
+from paddle_tpu.utils.log import logger, vlog, set_level
+from paddle_tpu.plot import Ploter
+
+
+class TestEnforce:
+    def test_enforce_carries_call_site(self):
+        with pytest.raises(EnforceNotMet) as ei:
+            enforce(False, "shape mismatch: %d vs %d", 3, 4)
+        assert "shape mismatch: 3 vs 4" in str(ei.value)
+        assert "test_utils_platform.py:" in str(ei.value)
+
+    def test_enforce_eq_and_not_none(self):
+        enforce_eq(2, 2)
+        assert enforce_not_none(5) == 5
+        with pytest.raises(EnforceNotMet):
+            enforce_eq(2, 3)
+        with pytest.raises(EnforceNotMet):
+            enforce_not_none(None)
+
+    def test_executor_uses_enforce(self):
+        from paddle_tpu import layers
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[2])
+            y = layers.fc(x, 2)
+        exe = ptpu.Executor()  # startup NOT run
+        with pytest.raises(EnforceNotMet, match="not initialized"):
+            exe.run(main, feed={"x": np.zeros((1, 2), "float32")},
+                    fetch_list=[y])
+
+
+class TestLogging:
+    def test_logger_and_vlog(self, capsys, monkeypatch):
+        lg = logger()
+        set_level("INFO")
+        lg.info("hello-info")
+        monkeypatch.setenv("PADDLE_TPU_VLOG", "2")
+        vlog(2, "vlog-visible")
+        vlog(3, "vlog-hidden")
+        set_level("WARNING")
+        err = capsys.readouterr().err
+        assert "hello-info" in err
+        assert "vlog-visible" in err
+        assert "vlog-hidden" not in err
+
+
+class TestImage:
+    def test_resize_short_and_crops(self):
+        im = np.arange(40 * 20 * 3, dtype="float32").reshape(40, 20, 3)
+        r = pimage.resize_short(im, 10)
+        assert r.shape == (20, 10, 3)  # short edge 20 -> 10, keep ratio
+        c = pimage.center_crop(r, 8)
+        assert c.shape == (8, 8, 3)
+        rc = pimage.random_crop(r, 8, rng=np.random.RandomState(0))
+        assert rc.shape == (8, 8, 3)
+        f = pimage.left_right_flip(c)
+        np.testing.assert_allclose(f[:, 0], c[:, -1])
+
+    def test_simple_transform_contract(self):
+        im = np.random.RandomState(0).rand(64, 48, 3).astype("float32")
+        out = pimage.simple_transform(im, 32, 24, is_train=False,
+                                      mean=[0.5, 0.5, 0.5])
+        assert out.shape == (3, 24, 24)
+        assert out.dtype == np.float32
+
+    def test_resize_identity_values(self):
+        im = np.random.RandomState(1).rand(8, 8).astype("float32")
+        np.testing.assert_allclose(pimage._resize(im, 8, 8), im)
+
+
+class TestPloter:
+    def test_append_and_csv(self, tmp_path):
+        p = Ploter("train", "test")
+        p.append("train", 0, 1.0)
+        p.append("train", 1, 0.5)
+        p.append("test", 1, 0.7)
+        csv = p.to_csv()
+        assert "train,0,1.0" in csv and "test,1,0.7" in csv
+        path = p.plot(str(tmp_path / "curve.png"))
+        assert path and (tmp_path / "curve.png").exists()
+        assert p.plot() == csv  # no path -> CSV text contract
+        with pytest.raises(KeyError):
+            p.append("nope", 0, 0)
+        p.reset()
+        assert p.to_csv().strip() == "title,step,value"
