@@ -14,7 +14,7 @@ from ..core import unique_name
 from ..layer_helper import LayerHelper
 
 __all__ = ["StaticRNN", "While", "cond", "less_than", "equal",
-           "greater_than", "Print"]
+           "greater_than", "Print", "recompute"]
 
 
 def _block_external_reads(block):
@@ -314,3 +314,51 @@ def Print(input, message=None, summarize=20, **kwargs):
                      attrs={"message": message or input.name,
                             "summarize": summarize})
     return out
+
+
+def recompute(fn, name=None, main_program=None):
+    """Gradient checkpointing (rematerialization): run ``fn``'s layers
+    with only their INPUTS saved for backward; the vjp recomputes the
+    internals (ops/control_flow_ops.py recompute_block ->
+    jax.checkpoint). Use around memory-heavy groups (e.g. each ResNet
+    residual block) to trade recompute flops for HBM traffic on a
+    bandwidth-bound step. ``fn`` must be rng-free.
+
+    Returns fn's Variable (or list/tuple of Variables)."""
+    helper = LayerHelper("recompute", name=name,
+                         main_program=main_program)
+    program = helper.main_program
+    parent = program.current_block()
+    sub = program.create_block()
+    out = fn()
+    program.rollback()
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+
+    captured = [n for n in _block_external_reads(sub)
+                if parent.has_var(n)]
+    # persistable outer vars the sub-block writes (batch_norm running
+    # stats, metric states): surfaced as StateOut so the updates
+    # escape the checkpointed scope and the executor persists them
+    state_writes = []
+    for n in _block_writes(sub):
+        v = parent.var(n) if parent.has_var(n) else None
+        if v is not None and v.persistable:
+            state_writes.append(n)
+    state_writes = sorted(state_writes)
+    new_outs = []
+    for v in outs:
+        nv = parent.create_var(
+            name=unique_name.generate("recompute.out"),
+            shape=v.shape, dtype=v.dtype)
+        new_outs.append(nv)
+    parent.append_op(
+        type="recompute_block",
+        inputs={"Captured": captured},
+        outputs={"Out": [v.name for v in new_outs],
+                 "StateOut": state_writes},
+        attrs={"sub_block": sub.idx,
+               "captured_vars": captured,
+               "output_vars": [v.name for v in outs],
+               "state_vars": state_writes},
+        infer_shape=False)
+    return new_outs[0] if len(new_outs) == 1 else new_outs

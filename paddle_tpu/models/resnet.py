@@ -50,10 +50,21 @@ def bottleneck(input, ch_out, stride, is_test=False):
     return layers.elementwise_add(short, conv3, act="relu")
 
 
-def layer_warp(block_func, input, ch_out, count, stride, is_test=False):
-    res = block_func(input, ch_out, stride, is_test)
+def layer_warp(block_func, input, ch_out, count, stride, is_test=False,
+               recompute=False):
+    """recompute: wrap each residual block in layers.recompute (gradient
+    checkpointing) — backward re-derives block internals from the block
+    input, cutting stored-activation HBM traffic on the bandwidth-bound
+    train step (see PROFILE.md)."""
+    def apply(x, stride_):
+        if recompute:
+            return layers.recompute(
+                lambda: block_func(x, ch_out, stride_, is_test))
+        return block_func(x, ch_out, stride_, is_test)
+
+    res = apply(input, stride)
     for i in range(1, count):
-        res = block_func(res, ch_out, 1, is_test)
+        res = apply(res, 1)
     return res
 
 
@@ -66,17 +77,18 @@ DEPTH_CFG = {
 }
 
 
-def resnet_imagenet(img, label, depth=50, class_dim=1000, is_test=False):
+def resnet_imagenet(img, label, depth=50, class_dim=1000, is_test=False,
+                    recompute=False):
     """Reference resnet.py ``resnet_imagenet``: 7x7/2 stem, 3x3/2 maxpool,
     4 stages, global avg pool, fc softmax."""
     block, stages = DEPTH_CFG[depth]
     conv1 = conv_bn_layer(img, 64, 7, 2, 3, is_test=is_test)
     pool1 = layers.pool2d(conv1, pool_size=3, pool_type="max",
                           pool_stride=2, pool_padding=1)
-    res1 = layer_warp(block, pool1, 64, stages[0], 1, is_test)
-    res2 = layer_warp(block, res1, 128, stages[1], 2, is_test)
-    res3 = layer_warp(block, res2, 256, stages[2], 2, is_test)
-    res4 = layer_warp(block, res3, 512, stages[3], 2, is_test)
+    res1 = layer_warp(block, pool1, 64, stages[0], 1, is_test, recompute)
+    res2 = layer_warp(block, res1, 128, stages[1], 2, is_test, recompute)
+    res3 = layer_warp(block, res2, 256, stages[2], 2, is_test, recompute)
+    res4 = layer_warp(block, res3, 512, stages[3], 2, is_test, recompute)
     pool2 = layers.pool2d(res4, pool_size=7, pool_type="avg",
                           global_pooling=True)
     flat_dim = pool2.shape[1]

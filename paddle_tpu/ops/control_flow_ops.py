@@ -177,6 +177,35 @@ def _while(ctx):
     return {"CarriedOut": list(final)}
 
 
+@register_op("recompute_block", skip_eval_shape=True)
+def _recompute_block(ctx):
+    """Gradient checkpointing over a sub-block (jax.checkpoint): the
+    forward runs normally, but only the block's INPUTS are stored for
+    backward — the vjp re-traces the sub-block to rebuild internal
+    activations. The TPU answer to activation-memory pressure: trades
+    MXU flops (abundant in a bandwidth-bound step, see PROFILE.md) for
+    HBM traffic. Sub-block ops must be deterministic (no rng ops)."""
+    program = ctx.block.program
+    sub = program.blocks[ctx.attr("sub_block")]
+    cap_names = ctx.attr("captured_vars")
+    out_names = ctx.attr("output_vars")
+    state_names = ctx.attr("state_vars") or []  # persistable writes
+    captured = dict(zip(cap_names, ctx.inputs("Captured")))
+    amp = _parent_amp(ctx)
+
+    @jax.checkpoint
+    def fn(cap):
+        env = dict(cap)
+        _run_sub_block(sub, env, amp=amp)
+        # persistable writes (e.g. batch_norm running stats) must leave
+        # the checkpointed scope or they would be silently dropped
+        return (tuple(env[n] for n in out_names),
+                tuple(env[n] for n in state_names))
+
+    outs, state = fn(captured)
+    return {"Out": list(outs), "StateOut": list(state)}
+
+
 @register_op("cond", skip_eval_shape=True)
 def _cond(ctx):
     """lax.cond over two traced branch blocks (reference

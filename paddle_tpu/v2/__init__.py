@@ -1,0 +1,42 @@
+"""paddle.v2-compatible API surface (reference ``python/paddle/v2``):
+the legacy keyword-argument layer DSL, SGD trainer, Parameters handle,
+datasets/readers/minibatch, and infer — lowered onto the TPU-native
+fluid-style engine. SURVEY hard-part 5 named this dual surface; a v2
+user ports scripts by changing only the import.
+
+    import paddle_tpu.v2 as paddle
+    paddle.init()
+    images = paddle.layer.data("pixel", paddle.data_type.dense_vector(784))
+    ...
+    trainer = paddle.trainer.SGD(cost, parameters, optimizer)
+    trainer.train(paddle.batch(paddle.dataset.mnist.train(), 64), ...)
+"""
+
+from .. import dataset  # noqa: F401  (same module names as v2.dataset)
+from .. import reader  # noqa: F401
+from ..reader import batch  # noqa: F401  (paddle.batch)
+from ..utils import image  # noqa: F401
+from .. import plot  # noqa: F401
+from . import activation  # noqa: F401
+from . import data_type  # noqa: F401
+from . import event  # noqa: F401
+from . import inference  # noqa: F401
+from . import layer  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import parameters  # noqa: F401
+from . import pooling  # noqa: F401
+from . import trainer  # noqa: F401
+from .. import nets as networks  # noqa: F401
+from .inference import infer  # noqa: F401
+from ..param_attr import ParamAttr as attr  # noqa: F401
+
+__all__ = ["init", "layer", "activation", "pooling", "data_type",
+           "event", "trainer", "parameters", "optimizer", "dataset",
+           "reader", "batch", "infer", "inference", "networks", "attr",
+           "image", "plot"]
+
+
+def init(use_gpu=False, trainer_count=1, **kwargs):
+    """v2 bootstrap (reference paddle.init parsing gflags): devices are
+    JAX-managed here; kept for script compatibility."""
+    return None

@@ -1,0 +1,40 @@
+"""v2 input-type descriptors (reference ``python/paddle/v2/data_type.py``
+re-exporting PyDataProvider2 types): each describes one feed slot's
+shape/dtype/sequence-ness; the v2 trainer builds the fluid-side data
+layout (padded batch + length var for sequences) from these."""
+
+__all__ = ["InputType", "dense_vector", "integer_value",
+           "dense_vector_sequence", "integer_value_sequence",
+           "sparse_binary_vector"]
+
+
+class InputType:
+    def __init__(self, dim, seq_type, dtype):
+        self.dim = dim
+        self.seq_type = seq_type  # 0 = no sequence, 1 = sequence
+        self.dtype = dtype
+
+    @property
+    def is_seq(self):
+        return self.seq_type != 0
+
+
+def dense_vector(dim):
+    return InputType(dim, 0, "float32")
+
+
+def integer_value(value_range):
+    return InputType(value_range, 0, "int64")
+
+
+def dense_vector_sequence(dim):
+    return InputType(dim, 1, "float32")
+
+
+def integer_value_sequence(value_range):
+    return InputType(value_range, 1, "int64")
+
+
+def sparse_binary_vector(dim):
+    # realized as an id-sequence feed (ids of the set bits)
+    return InputType(dim, 1, "int64")

@@ -1,0 +1,8 @@
+"""v2 training events (reference ``python/paddle/v2/event.py``) — the
+fluid-side Trainer already emits this exact protocol; re-exported under
+the v2 names."""
+
+from ..trainer import (BeginIteration, EndIteration, BeginPass,  # noqa
+                       EndPass)
+
+__all__ = ["BeginIteration", "EndIteration", "BeginPass", "EndPass"]

@@ -1,0 +1,44 @@
+"""v2 inference (reference ``python/paddle/v2/inference.py:24-125``
+infer(output_layer, parameters, input, feeding)): prune the topology to
+the output layer and run it over a list of input samples."""
+
+import numpy as np
+
+from ..core.executor import Executor
+from ..core.framework import default_main_program
+from ..io import prune_program
+from .trainer import _build_feeder
+
+__all__ = ["infer", "Inference"]
+
+
+class Inference:
+    def __init__(self, output_layer, parameters):
+        outputs = output_layer if isinstance(output_layer, (list, tuple)) \
+            else [output_layer]
+        self._outputs = outputs
+        self._program = prune_program(default_main_program(),
+                                      [v.name for v in outputs])
+        self._exe = Executor()
+
+    def infer(self, input, feeding=None, field="value"):
+        if feeding is None:
+            if isinstance(input, dict):
+                feed = input  # already a name -> array feed dict
+            else:
+                raise ValueError(
+                    "v2 infer needs feeding={layer_name: sample_index} "
+                    "for tuple-sample input (or pass a feed dict)")
+        else:
+            feeder = _build_feeder(feeding, len(input[0]))
+            feed = feeder.feed(input)
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=[v.name for v in self._outputs])
+        outs = [np.asarray(v) for v in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value"):
+    return Inference(output_layer, parameters).infer(input,
+                                                     feeding=feeding,
+                                                     field=field)

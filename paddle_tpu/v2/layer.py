@@ -1,0 +1,153 @@
+"""v2 layer DSL (reference ``python/paddle/v2/layer.py`` +
+``trainer_config_helpers/layers.py`` ~85 funcs): the keyword-argument
+graph-builder surface of the legacy API, lowered onto the fluid-style
+layers. Sequence-typed data layers produce a padded (data, length) pair
+under the hood (the LoD replacement, SURVEY §5.7); every v2 layer that
+consumed LoD consults the hidden length var.
+"""
+
+from .. import layers as _L
+from .. import nets as _nets
+from . import data_type as _dt
+
+__all__ = ["data", "fc", "embedding", "pooling", "concat",
+           "classification_cost", "regression_cost", "mse_cost",
+           "cross_entropy_cost", "lstmemory_group", "gru_group",
+           "max_id", "dropout", "img_conv", "img_pool", "batch_norm"]
+
+# var name -> (InputType, length var or None); the v2 feeding table
+_INPUT_TYPES = {}
+
+
+def _length_of(var):
+    entry = _INPUT_TYPES.get(getattr(var, "_v2_source", None) or var.name)
+    return entry[1] if entry else getattr(var, "_v2_length", None)
+
+
+def _tag(out, src):
+    """Propagate the sequence-length var through unary layers."""
+    ln = _length_of(src)
+    if ln is not None:
+        out._v2_length = ln
+    return out
+
+
+def data(name, type, **kwargs):
+    """v2 data layer: shape/dtype/sequence-ness from the InputType."""
+    if type.is_seq:
+        var = _L.data(name, shape=[None], dtype=type.dtype, **kwargs)
+        length = _L.data(name + "@len", shape=[], dtype="int64",
+                         **kwargs)
+        var._v2_length = length
+        _INPUT_TYPES[var.name] = (type, length)
+        return var
+    shape = [type.dim] if type.dtype == "float32" else [1]
+    var = _L.data(name, shape=shape, dtype=type.dtype, **kwargs)
+    _INPUT_TYPES[var.name] = (type, None)
+    return var
+
+
+def _act_name(act):
+    return getattr(act, "name", act) if act is not None else None
+
+
+def fc(input, size, act=None, param_attr=None, bias_attr=None, **kwargs):
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    ndim = max(len(v.shape or ()) for v in inputs)
+    out = _L.fc(input, size, act=_act_name(act), param_attr=param_attr,
+                bias_attr=bias_attr,
+                num_flatten_dims=2 if ndim >= 3 else 1, **kwargs)
+    if _act_name(act) == "softmax":
+        out._v2_softmaxed = True  # classification_cost picks plain CE
+    return _tag(out, inputs[0])
+
+
+def embedding(input, size, param_attr=None, **kwargs):
+    entry = _INPUT_TYPES.get(input.name)
+    vocab = entry[0].dim if entry else None
+    if vocab is None:
+        raise ValueError("embedding needs a data layer with "
+                         "integer_value[_sequence] type")
+    out = _L.embedding(input, size=[vocab, size], param_attr=param_attr,
+                       **kwargs)
+    return _tag(out, input)
+
+
+def pooling(input, pooling_type=None, **kwargs):
+    """Sequence pooling over the time axis (v2 pooling layer)."""
+    ptype = getattr(pooling_type, "name", None) or "max"
+    return _L.sequence_pool(input, ptype, length=_length_of(input),
+                            **kwargs)
+
+
+def concat(input, **kwargs):
+    return _L.concat(list(input), axis=-1, **kwargs)
+
+
+def dropout(input, dropout_rate=0.5, **kwargs):
+    return _tag(_L.dropout(input, dropout_prob=dropout_rate, **kwargs),
+                input)
+
+
+def classification_cost(input, label, **kwargs):
+    """softmax_with_cross_entropy mean (v2 classification_cost: the
+    input is pre-softmax unless already activated; reference applies
+    softmax inside the cost when the layer's act is Softmax — here the
+    convention is: pass logits OR softmax output, cross_entropy picks
+    the right path by checking the producing layer)."""
+    if getattr(input, "_v2_softmaxed", False):
+        return _L.mean(_L.cross_entropy(input, label, **kwargs))
+    return _L.mean(_L.softmax_with_cross_entropy(input, label, **kwargs))
+
+
+def cross_entropy_cost(input, label, **kwargs):
+    return _L.mean(_L.cross_entropy(input, label, **kwargs))
+
+
+def regression_cost(input, label, **kwargs):
+    return _L.mean(_L.square_error_cost(input, label, **kwargs))
+
+
+mse_cost = regression_cost
+
+
+def lstmemory_group(input, size, reverse=False, **kwargs):
+    """v2 simple_lstm-style group over a sequence input."""
+    out = _nets.simple_lstm(input, size, length=_length_of(input),
+                            is_reverse=reverse, **kwargs)
+    return _tag(out, input)
+
+
+def gru_group(input, size, reverse=False, **kwargs):
+    out = _nets.simple_gru(input, size, length=_length_of(input),
+                           is_reverse=reverse, **kwargs)
+    return _tag(out, input)
+
+
+def max_id(input, **kwargs):
+    out, idx = _L.topk(input, k=1, **kwargs)
+    return idx
+
+
+def img_conv(input, filter_size, num_filters, act=None, padding=0,
+             stride=1, **kwargs):
+    return _L.conv2d(input, num_filters=num_filters,
+                     filter_size=filter_size, padding=padding,
+                     stride=stride, act=_act_name(act), **kwargs)
+
+
+def img_pool(input, pool_size, pool_type=None, stride=1, **kwargs):
+    ptype = getattr(pool_type, "name", None) or "max"
+    if ptype == "average":
+        ptype = "avg"
+    return _L.pool2d(input, pool_size=pool_size, pool_type=ptype,
+                     pool_stride=stride, **kwargs)
+
+
+def batch_norm(input, act=None, **kwargs):
+    return _L.batch_norm(input, act=_act_name(act), **kwargs)
+
+
+def parse_network(*outputs):
+    """v2 topology hook — programs ARE the topology here."""
+    return list(outputs)

@@ -1,0 +1,46 @@
+"""v2 Parameters handle (reference ``python/paddle/v2/parameters.py``):
+a named view over the trained parameter values. Here parameters live in
+the global Scope; ``create(cost)`` snapshots the topology's parameter
+names and the handle reads/writes the scope."""
+
+import numpy as np
+
+from ..core.scope import global_scope
+
+__all__ = ["Parameters", "create"]
+
+
+class Parameters:
+    def __init__(self, names):
+        self._names = list(names)
+
+    def names(self):
+        return list(self._names)
+
+    def keys(self):
+        return self.names()
+
+    def __contains__(self, name):
+        return name in self._names
+
+    def get(self, name):
+        v = global_scope().find_var(name)
+        return None if v is None else np.asarray(v)
+
+    __getitem__ = get
+
+    def set(self, name, value):
+        global_scope().set_var(name, np.asarray(value))
+
+    __setitem__ = set
+
+    def to_dict(self):
+        return {n: self.get(n) for n in self._names}
+
+
+def create(cost):
+    """Collect the trainable parameters reachable from ``cost``'s
+    program (v2 parameters.create)."""
+    costs = cost if isinstance(cost, (list, tuple)) else [cost]
+    block = costs[0].block.program.global_block()
+    return Parameters([p.name for p in block.all_parameters()])

@@ -1,0 +1,19 @@
+"""v2 pooling descriptors (reference ``python/paddle/v2/pooling.py``)."""
+
+__all__ = ["Max", "Avg", "Sum"]
+
+
+class _Pool:
+    name = None
+
+
+class Max(_Pool):
+    name = "max"
+
+
+class Avg(_Pool):
+    name = "average"
+
+
+class Sum(_Pool):
+    name = "sum"

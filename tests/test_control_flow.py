@@ -349,3 +349,94 @@ class TestWhileBackward:
         # d mean(x@w) / dw = 1/(2*2) * x^T @ ones = 0.25 * [[2,2],...]
         np.testing.assert_allclose(g_true, np.full((4, 2), 0.5), atol=1e-6)
         np.testing.assert_allclose(g_false, np.full((4, 2), 1.5), atol=1e-6)
+
+
+class TestRecompute:
+    def test_recompute_matches_plain_gradients(self):
+        """layers.recompute (gradient checkpointing) must change memory
+        behavior only: outputs and parameter gradients identical."""
+        from paddle_tpu.core.backward import append_backward
+
+        def build(use_recompute):
+            main, startup = ptpu.Program(), ptpu.Program()
+            with ptpu.program_guard(main, startup):
+                x = layers.data("x", shape=[4])
+                w = main.global_block().create_parameter(
+                    name="rc_w", shape=[4, 4], dtype="float32",
+                    initializer=ptpu.initializer.Constant(0.0))
+                sv = startup.global_block().create_var(
+                    name="rc_w", shape=[4, 4], dtype="float32",
+                    persistable=True)
+                ptpu.initializer.Constant(0.0)(sv,
+                                               startup.global_block())
+
+                def blockfn():
+                    h = layers.relu(layers.mul(x, w))
+                    return layers.elementwise_add(h, x)
+
+                if use_recompute:
+                    out = layers.recompute(blockfn)
+                else:
+                    out = blockfn()
+                loss = layers.mean(layers.square(out))
+                append_backward(loss, parameter_list=["rc_w"])
+            return main, startup, loss
+
+        rs = np.random.RandomState(0)
+        xv = rs.randn(3, 4).astype("float32")
+        wv = rs.randn(4, 4).astype("float32")
+        results = []
+        for use in (False, True):
+            with ptpu.scope_guard(ptpu.Scope()), \
+                    ptpu.unique_name.guard():
+                main, startup, loss = build(use)
+                exe = ptpu.Executor()
+                exe.run(startup)
+                ptpu.global_scope().set_var("rc_w", wv)
+                got = exe.run(main, feed={"x": xv},
+                              fetch_list=[loss, "rc_w@GRAD"])
+                results.append([np.asarray(v) for v in got])
+        np.testing.assert_allclose(results[0][0], results[1][0],
+                                   rtol=1e-6)
+        assert np.abs(results[0][1]).max() > 1e-6
+        np.testing.assert_allclose(results[0][1], results[1][1],
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_recompute_preserves_batch_norm_running_stats(self):
+        """Persistable writes inside a recompute block (BN running
+        stats) must escape the checkpointed scope and update."""
+        def run(use_recompute):
+            main, startup = ptpu.Program(), ptpu.Program()
+            main.random_seed = startup.random_seed = 4
+            with ptpu.program_guard(main, startup):
+                x = layers.data("x", shape=[3, 4, 4])
+                def blockfn():
+                    return layers.batch_norm(
+                        layers.conv2d(x, num_filters=3, filter_size=3,
+                                      padding=1, bias_attr=False),
+                        act="relu")
+                out = layers.recompute(blockfn) if use_recompute \
+                    else blockfn()
+                loss = layers.mean(out)
+                ptpu.optimizer.SGD(learning_rate=0.1).minimize(
+                    loss, startup_program=startup)
+            exe = ptpu.Executor()
+            exe.run(startup)
+            xv = np.random.RandomState(0).randn(2, 3, 4, 4).astype(
+                "float32")
+            exe.run(main, feed={"x": xv}, fetch_list=[loss])
+            scope = ptpu.global_scope()
+            # BN running stats are batch_norm_N.global_0 (mean)
+            stats = [np.asarray(scope.find_var(n))
+                     for n in sorted(scope.var_names())
+                     if "batch_norm" in n and "global_0" in n]
+            return stats
+
+        with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+            plain = run(False)
+        with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+            ckpt = run(True)
+        assert plain and ckpt
+        for a, b in zip(plain, ckpt):
+            assert np.abs(a).max() > 0  # stats updated in plain run
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)

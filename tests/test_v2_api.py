@@ -1,0 +1,103 @@
+"""v2 API surface (paddle_tpu.v2; reference python/paddle/v2): port of
+the book recognize_digits MLP and a sequence classifier written in the
+LEGACY style — only the import changes for a v2 user."""
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+
+
+def _digits_reader(n, seed=0):
+    def reader():
+        rs = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rs.randint(0, 10))
+            im = rs.rand(64).astype("float32") * 0.1
+            im[label * 6:(label * 6) + 6] += 1.0  # separable pattern
+            yield im, label
+    return reader
+
+
+def test_v2_mlp_trains_tests_and_infers():
+    paddle.init(use_gpu=False, trainer_count=1)
+    images = paddle.layer.data("pixel",
+                               paddle.data_type.dense_vector(64))
+    label = paddle.layer.data("label",
+                              paddle.data_type.integer_value(10))
+    hidden = paddle.layer.fc(images, size=32,
+                             act=paddle.activation.Relu())
+    predict = paddle.layer.fc(hidden, size=10,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    assert parameters.names()
+    optimizer = paddle.optimizer.Momentum(learning_rate=0.1,
+                                          momentum=0.9)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=optimizer)
+
+    costs = []
+
+    def handler(event):
+        if isinstance(event, paddle.event.EndIteration):
+            costs.append(event.cost)
+
+    feeding = {"pixel": 0, "label": 1}
+    trainer.train(paddle.batch(_digits_reader(512), 32),
+                  num_passes=3, event_handler=handler, feeding=feeding)
+    assert costs[-1] < 0.5 * costs[0], (costs[0], costs[-1])
+
+    result = trainer.test(paddle.batch(_digits_reader(128, seed=9), 32),
+                          feeding=feeding)
+    assert result.cost < 1.0
+
+    # v2 infer on raw samples (label slot unused by the pruned graph)
+    samples = list(_digits_reader(16, seed=3)())
+    probs = paddle.infer(output_layer=predict, parameters=parameters,
+                         input=samples, feeding=feeding)
+    assert probs.shape == (16, 10)
+    pred = probs.argmax(1)
+    truth = np.array([s[1] for s in samples])
+    assert (pred == truth).mean() > 0.8
+
+    # parameters handle reads real trained values
+    w = parameters[parameters.names()[0]]
+    assert np.abs(w).max() > 0
+
+
+def test_v2_sequence_classifier():
+    paddle.init()
+    words = paddle.layer.data(
+        "words", paddle.data_type.integer_value_sequence(100))
+    label = paddle.layer.data("lbl",
+                              paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(words, size=16)
+    pooled = paddle.layer.pooling(emb,
+                                  pooling_type=paddle.pooling.Avg())
+    predict = paddle.layer.fc(pooled, size=2,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+    parameters = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Adam(learning_rate=5e-3)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=optimizer)
+
+    def reader():
+        rs = np.random.RandomState(1)
+        for _ in range(256):
+            lab = int(rs.randint(0, 2))
+            ln = int(rs.randint(5, 30))
+            ids = rs.randint(10, 100, ln)
+            if lab:
+                ids[: max(2, ln // 3)] = 7
+            yield ids.astype("int64").tolist(), lab
+
+    costs = []
+    trainer.train(
+        paddle.batch(reader, 16), num_passes=6,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None,
+        feeding={"words": 0, "lbl": 1})
+    assert np.mean(costs[-8:]) < 0.7 * np.mean(costs[:8]), \
+        (np.mean(costs[:8]), np.mean(costs[-8:]))
