@@ -59,6 +59,10 @@ def _lookup(env, name, op, block):
 AMP_WHITE = frozenset({
     "conv2d", "conv3d", "conv2d_transpose", "conv3d_transpose",
     "mul", "matmul", "bilinear_tensor_product",
+    # fused recurrent scans: per-step gate matmuls dominate; the scan
+    # carries stay bf16 end-to-end (cast once at the boundary)
+    "dynamic_lstm", "dynamic_gru", "attention_gru_decoder",
+    "sequence_conv",
 })
 AMP_BLACK = frozenset({
     "cross_entropy", "softmax_with_cross_entropy",

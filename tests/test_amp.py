@@ -134,3 +134,47 @@ def test_amp_inside_bounded_while_keeps_carry_dtype():
         assert np.abs(wv - 0.1).max() > 1e-6
     finally:
         ptpu.config.set_flags(amp=None)
+
+
+def test_amp_rnn_trains_like_f32():
+    """dynamic_gru in the amp white list: bf16 scan carries must track
+    the f32 training trajectory on a learnable sequence task."""
+    def run(amp):
+        ptpu.config.set_flags(amp=amp)
+        try:
+            main, startup = ptpu.Program(), ptpu.Program()
+            main.random_seed = startup.random_seed = 13
+            with ptpu.program_guard(main, startup):
+                x = layers.data("x", shape=[6, 4])
+                y = layers.data("y", shape=[1])
+                proj = layers.fc(x, 3 * 8, num_flatten_dims=2)
+                h = layers.dynamic_gru(proj, 8)
+                last = layers.sequence_pool(h, "last")
+                pred = layers.fc(last, 1)
+                loss = layers.mean(layers.square_error_cost(pred, y))
+                ptpu.optimizer.Adam(learning_rate=5e-3).minimize(
+                    loss, startup_program=startup)
+            exe = ptpu.Executor()
+            exe.run(startup)
+            rs = np.random.RandomState(0)
+            losses = []
+            for _ in range(80):
+                xv = rs.randn(16, 6, 4).astype("float32")
+                yv = xv.sum(axis=(1, 2)).reshape(-1, 1) * 0.1
+                out, = exe.run(main, feed={"x": xv, "y": yv},
+                               fetch_list=[loss])
+                losses.append(float(out))
+            return losses
+        finally:
+            ptpu.config.set_flags(amp=None)
+
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        f32 = run(None)
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        bf16 = run("bfloat16")
+    # both converge; trajectories agree to bf16 resolution early on and
+    # end in the same regime
+    assert bf16[-1] < 0.3 * bf16[0], (bf16[0], bf16[-1])
+    np.testing.assert_allclose(bf16[:5], f32[:5], rtol=0.1, atol=0.05)
+    assert abs(np.mean(bf16[-10:]) - np.mean(f32[-10:])) < \
+        0.25 * max(np.mean(f32[-10:]), 0.05)
