@@ -3,4 +3,4 @@ the paddle_tpu layers API (reference ``benchmark/paddle/image/*.py``,
 ``fluid/tests/book/*``)."""
 
 from . import lenet, alexnet, vgg, resnet, googlenet, smallnet  # noqa: F401
-from . import lstm_sentiment, wide_deep, seq2seq  # noqa: F401
+from . import lstm_sentiment, wide_deep, seq2seq, ssd  # noqa: F401

@@ -1,0 +1,169 @@
+"""Remaining reference book examples (fluid/tests/book):
+word2vec (n-gram LM, shared sparse embedding), recommender_system
+(two-tower movielens with cos_sim), and the SSD detector model
+(train + infer over the detection family)."""
+
+import itertools
+
+import numpy as np
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+from paddle_tpu.dataset import imikolov
+
+
+def test_word2vec_ngram_trains():
+    """book test_word2vec.py: 4 context words -> next word, one SHARED
+    embedding table."""
+    word_dict = imikolov.build_dict()
+    dict_size = len(word_dict)
+    EMB = 32
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        words = [layers.data(n, shape=[1], dtype="int64")
+                 for n in ("firstw", "secondw", "thirdw", "forthw")]
+        nextw = layers.data("nextw", shape=[1], dtype="int64")
+        embs = [layers.embedding(w, size=[dict_size, EMB],
+                                 param_attr="shared_w") for w in words]
+        concat = layers.concat(embs, axis=1)
+        hidden = layers.fc(concat, 64, act="sigmoid")
+        logits = layers.fc(hidden, dict_size)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, nextw))
+        ptpu.optimizer.Adam(learning_rate=5e-3).minimize(
+            loss, startup_program=startup)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    losses = []
+    data = list(itertools.islice(imikolov.train(word_dict, 5)(), 2048))
+    for _ in range(3):  # epochs: the n-gram chain is memorizable
+        for i in range(0, len(data), 64):
+            cols = list(zip(*data[i:i + 64]))
+            feed = {n: np.array(c, "int64").reshape(-1, 1)
+                    for n, c in zip(
+                        ("firstw", "secondw", "thirdw", "forthw",
+                         "nextw"), cols)}
+            out, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(out))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), \
+        (np.mean(losses[:5]), np.mean(losses[-5:]))
+
+
+def test_recommender_system_trains():
+    """book test_recommender_system.py: user tower (id/gender/age/job)
+    + movie tower (id/category/title) -> cos_sim vs rating."""
+    U, M, C, G, A, J = 944, 1683, 19, 2, 8, 21
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        uid = layers.data("user_id", shape=[1], dtype="int64")
+        gender = layers.data("gender_id", shape=[1], dtype="int64")
+        age = layers.data("age_id", shape=[1], dtype="int64")
+        job = layers.data("job_id", shape=[1], dtype="int64")
+        mid = layers.data("movie_id", shape=[1], dtype="int64")
+        cat = layers.data("category_id", shape=[None], dtype="int64")
+        cat_len = layers.data("category_len", shape=[], dtype="int64")
+        score = layers.data("score", shape=[1])
+
+        usr = layers.concat([
+            layers.fc(layers.embedding(uid, size=[U, 32]), 32),
+            layers.fc(layers.embedding(gender, size=[G, 16]), 16),
+            layers.fc(layers.embedding(age, size=[A, 16]), 16),
+            layers.fc(layers.embedding(job, size=[J, 16]), 16)], axis=1)
+        usr_feat = layers.fc(usr, 64, act="tanh")
+
+        mov = layers.concat([
+            layers.fc(layers.embedding(mid, size=[M, 32]), 32),
+            layers.sequence_pool(layers.embedding(
+                cat, size=[C, 16]), "sum", length=cat_len)], axis=1)
+        mov_feat = layers.fc(mov, 64, act="tanh")
+
+        sim = layers.cos_sim(usr_feat, mov_feat)
+        pred = layers.scale(sim, 5.0)
+        loss = layers.mean(layers.square_error_cost(pred, score))
+        ptpu.optimizer.Adam(learning_rate=5e-3).minimize(
+            loss, startup_program=startup)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    losses = []
+    maxc = 4
+    for _ in range(60):
+        n = 32
+        # synthetic but learnable: score correlates with (uid+mid) parity
+        u = rs.randint(0, U, (n, 1))
+        m = rs.randint(0, M, (n, 1))
+        cats = rs.randint(0, C, (n, maxc))
+        clen = rs.randint(1, maxc + 1, (n,))
+        sc = ((u + m) % 5).astype("float32")
+        feed = {"user_id": u.astype("int64"),
+                "gender_id": rs.randint(0, G, (n, 1)).astype("int64"),
+                "age_id": rs.randint(0, A, (n, 1)).astype("int64"),
+                "job_id": rs.randint(0, J, (n, 1)).astype("int64"),
+                "movie_id": m.astype("int64"),
+                "category_id": cats.astype("int64"),
+                "category_len": clen.astype("int64"),
+                "score": sc}
+        out, = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(out))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-10:]) < np.mean(losses[:10])
+
+
+class TestSSDModel:
+    def test_ssd_trains_and_infers(self):
+        from paddle_tpu.models.ssd import ssd_net
+        H = W = 32
+        G = 2
+        main, startup = ptpu.Program(), ptpu.Program()
+        # both graphs build under fresh name counters so the infer net
+        # shares the trained parameters by identical names
+        with ptpu.unique_name.guard():
+            with ptpu.program_guard(main, startup):
+                img = layers.data("img", shape=[3, H, W])
+                gb = layers.data("gb", shape=[G, 4])
+                gl = layers.data("gl", shape=[G], dtype="int64")
+                gc = layers.data("gc", shape=[], dtype="int64")
+                loss, ll, cl = ssd_net(img, num_classes=4, gt_box=gb,
+                                       gt_label=gl, gt_count=gc)
+                ptpu.optimizer.Adam(learning_rate=2e-3).minimize(
+                    loss, startup_program=startup)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        losses = []
+        for _ in range(30):
+            n = 4
+            imv = rs.rand(n, 3, H, W).astype("float32")
+            boxes = np.zeros((n, G, 4), "float32")
+            labels = np.zeros((n, G), "int64")
+            for i in range(n):
+                x0, y0 = rs.uniform(0.0, 0.5, 2)
+                boxes[i, 0] = [x0, y0, x0 + 0.4, y0 + 0.4]
+                labels[i, 0] = rs.randint(1, 4)
+                # paint the object so it is learnable
+                xs, ys = int(x0 * W), int(y0 * H)
+                imv[i, labels[i, 0] % 3, ys:ys + int(0.4 * H),
+                    xs:xs + int(0.4 * W)] += 1.0
+            feed = {"img": imv, "gb": boxes, "gl": labels,
+                    "gc": np.ones((n,), "int64")}
+            out, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(out).ravel()[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+        # inference graph shares the trained parameters by name
+        with ptpu.unique_name.guard():
+            infer_main, infer_start = ptpu.Program(), ptpu.Program()
+            with ptpu.program_guard(infer_main, infer_start):
+                img2 = layers.data("img", shape=[3, H, W])
+                dets = ssd_net(img2, num_classes=4, mode="infer",
+                               keep_top_k=8)
+            got, = exe.run(infer_main,
+                           feed={"img": rs.rand(2, 3, H, W).astype(
+                               "float32")},
+                           fetch_list=[dets])
+        assert got.shape == (2, 8, 6)
+        kept = got[got[:, :, 0] >= 0]
+        if kept.size:  # any detection has sane geometry + class range
+            assert (kept[:, 0] >= 1).all() and (kept[:, 0] < 4).all()

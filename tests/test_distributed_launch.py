@@ -25,23 +25,29 @@ def _free_port():
 
 
 def _spawn_round(repo, worker, env):
+    """Run both workers; returns [(proc, output, timed_out)] with the
+    output captured even for workers we had to kill."""
     port = _free_port()
     procs = []
+    rows = []
     try:
         for pid in range(2):
             procs.append(subprocess.Popen(
                 [sys.executable, worker, repo, str(port), str(pid), "2"],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 env=env, text=True))
-        outs = []
         for p in procs:
             try:
                 out, _ = p.communicate(timeout=240)
+                rows.append((p, out, False))
             except subprocess.TimeoutExpired:
                 p.kill()
-                return None, "timeout"
-            outs.append(out)
-        return list(zip(procs, outs)), None
+                try:
+                    out, _ = p.communicate(timeout=10)
+                except Exception:
+                    out = "<no output captured>"
+                rows.append((p, out, True))
+        return rows
     finally:
         for p in procs:
             if p.poll() is None:
@@ -56,24 +62,24 @@ def test_two_process_global_mesh_all_reduce():
     # sitecustomize pre-registers an accelerator plugin otherwise
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    # one retry: the freed coordinator port can be raced by another
-    # process between _free_port() and the coordinator's bind
-    results, failure = None, None
+    # one retry when any worker fails on its own (e.g. the freed
+    # coordinator port raced away between _free_port() and bind —
+    # typically one worker exits fast and its PEER blocks, so a mixed
+    # fail+timeout round is a failure round, not a timeout round)
+    rows = None
     for attempt in range(2):
-        rr, err = _spawn_round(repo, worker, env)
-        if err == "timeout":
-            if failure is None:
-                pytest.skip("distributed workers timed out "
-                            "(coordinator blocked in this env)")
-            break  # report the concrete failure from the first attempt
-        if all(p.returncode == 0 for p, _ in rr):
-            results = rr
+        rows = _spawn_round(repo, worker, env)
+        if all(p.returncode == 0 for p, _, _ in rows):
             break
-        failure = rr
-    if results is None:
-        for pid, (p, out) in enumerate(failure):
-            assert p.returncode == 0, "worker %d failed:\n%s" % (pid, out)
-    outs = [out for _, out in results]
+        self_failed = [p for p, _, timed in rows
+                       if not timed and p.returncode != 0]
+        if not self_failed:
+            pytest.skip("distributed workers timed out "
+                        "(coordinator blocked in this env)")
+    for pid, (p, out, timed) in enumerate(rows):
+        assert p.returncode == 0, "worker %d %s:\n%s" % (
+            pid, "timed out" if timed else "failed", out)
+    outs = [out for _, out, _ in rows]
     for pid, out in enumerate(outs):
         assert "WORKER_OK %d" % pid in out, out
     # both processes computed the SAME replicated global loss
