@@ -31,6 +31,9 @@ _flags = {
     "matmul_precision": None,
     "check_nan_inf": False,
     "amp": None,
+    # Pallas fused attention kernel for multihead_attention (see
+    # ops/pallas_attention.py); interpret-mode off-TPU
+    "flash_attention": False,
 }
 
 

@@ -39,6 +39,15 @@ def _multihead_attention(ctx):
                                       causal=causal)
         return {"Out": out.reshape(b, tq, dm)}
 
+    from .. import config as _config
+    if _config.get_flag("flash_attention") and tq == tk and \
+            not ctx.has_input("KeyLength"):
+        from .pallas_attention import flash_attention
+        out = flash_attention(qh.transpose(0, 2, 1, 3),
+                              kh.transpose(0, 2, 1, 3),
+                              vh.transpose(0, 2, 1, 3), causal=causal)
+        return {"Out": out.transpose(0, 2, 1, 3).reshape(b, tq, dm)}
+
     s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
                    preferred_element_type=jnp.float32) * (hd ** -0.5)
     neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)

@@ -1,0 +1,200 @@
+"""Pallas flash attention — the hand-scheduled TPU kernel for the one
+op where XLA's default schedule materializes an O(T^2) intermediate.
+
+The fused kernel streams K/V from VMEM against one Q block at a time:
+scores, causal mask, softmax, and the P@V contraction all happen
+on-chip, so the [T, T] probability matrix never exists in HBM (the XLA
+fallback in ops/attention_ops.py writes it out between the two
+einsums). Forward is the Pallas kernel; backward is a flash-style
+CHUNKED recompute under jax.custom_vjp — probabilities are rebuilt one
+q-chunk at a time (peak O(block_q * T) per batch-head), so training at
+long T stays in-memory too; residuals are just q, k, v.
+
+Used by the multihead_attention op when the ``flash_attention`` config
+flag is on (interpret mode on CPU keeps it testable everywhere);
+`/opt`-guide tiling notes: blocks keep the last dim = head_dim and
+block_q rows per grid step.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+
+
+def _reference(q, k, v, causal):
+    """Plain jnp attention over [BH, T, D] (the backward path)."""
+    s = jnp.einsum("bqd,bkd->bqk", q, k,
+                   preferred_element_type=jnp.float32)
+    s = s * (q.shape[-1] ** -0.5)
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+            scale, causal, block_q, block_k, nk):
+    """One (q-block, k-block) step of flash attention with online
+    softmax. The k axis is the innermost (sequential) grid dim, so the
+    VMEM scratch (acc, running max m, running sum l) carries across
+    k blocks of the same q block."""
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full(m_ref.shape, _NEG, m_ref.dtype)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: skip k blocks strictly above this q block's last row
+    live = (qi * block_q + block_q - 1 >= ki * block_k) \
+        if causal else True
+
+    @pl.when(live)
+    def _step():
+        s = jnp.dot(q_ref[0], k_ref[0].T,
+                    preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            mask = rows >= cols
+            s = jnp.where(mask, s, _NEG)
+        m_prev = m_ref[:]                          # [bq, 128]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        if causal:
+            p = jnp.where(mask, p, 0.0)  # kill fully-masked rows
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=-1,
+                                              keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha[:, :1] + jnp.dot(
+            p.astype(v_ref.dtype), v_ref[0],
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _block_size(t, cap):
+    """Largest divisor of t that is <= cap and >= 128 (or t itself when
+    shorter) — avoids silently falling back to the dense path for
+    tileable lengths like 768 or 1280."""
+    if t <= cap:
+        return t
+    for b in range(cap, 127, -1):
+        if t % b == 0:
+            return b
+    return 0
+
+
+def _forward(q, k, v, causal, block_q, interpret):
+    bh, t, d = q.shape
+    bq = _block_size(t, block_q)
+    bk = _block_size(t, 512)
+    if not bq or not bk:
+        return _reference(q, k, v, causal)  # ragged length: XLA path
+    from jax.experimental.pallas import tpu as pltpu
+    grid = (bh, t // bq, t // bk)
+    return pl.pallas_call(
+        functools.partial(_kernel, scale=d ** -0.5, causal=causal,
+                          block_q=bq, block_k=bk, nk=t // bk),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),     # acc
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max
+            pltpu.VMEM((bq, 128), jnp.float32),   # running sum
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, interpret):
+    return _forward(q, k, v, causal, block_q, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, interpret):
+    return _forward(q, k, v, causal, block_q, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, interpret, res, g):
+    """Flash-style chunked backward: recompute probabilities one
+    q-chunk at a time, so peak memory is O(bq * T) per batch-head —
+    never the full [T, T] score matrix (training at T=8192 stays
+    in-memory where the dense backward OOMs)."""
+    q, k, v = res
+    bh, t, d = q.shape
+    scale = d ** -0.5
+    bq = _block_size(t, block_q)
+    if not bq:
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _reference(q_, k_, v_, causal), q, k, v)
+        return vjp(g)
+    nb = t // bq
+    qc = q.reshape(bh, nb, bq, d)
+    gc = g.reshape(bh, nb, bq, d)
+    cols = jnp.arange(t)
+
+    def chunk(carry, idx):
+        dk, dv = carry
+        qb = qc[:, idx]                    # [bh, bq, d]
+        gb = gc[:, idx]
+        s = jnp.einsum("bqd,bkd->bqk", qb, k,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = idx * bq + jnp.arange(bq)
+            s = jnp.where(rows[None, :, None] >= cols[None, None, :],
+                          s, _NEG)
+        p = jax.nn.softmax(s, axis=-1)
+        dp = jnp.einsum("bqd,bkd->bqk", gb, v,
+                        preferred_element_type=jnp.float32)
+        ds = (dp - jnp.sum(dp * p, axis=-1, keepdims=True)) * p
+        dqb = jnp.einsum("bqk,bkd->bqd", ds, k) * scale
+        dk = dk + jnp.einsum("bqk,bqd->bkd", ds, qb) * scale
+        dv = dv + jnp.einsum("bqk,bqd->bkd", p, gb)
+        return (dk, dv), dqb.astype(q.dtype)
+
+    (dk, dv), dqs = jax.lax.scan(
+        chunk, (jnp.zeros(k.shape, jnp.float32),
+                jnp.zeros(v.shape, jnp.float32)), jnp.arange(nb))
+    dq = jnp.moveaxis(dqs, 0, 1).reshape(bh, t, d)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, block_q=256,
+                    interpret=None):
+    """q, k, v: [B, H, T, D] (or [BH, T, D]) -> same-shape output.
+    Fused Pallas forward + recompute backward. ``interpret=None``
+    auto-selects interpreter mode off-TPU."""
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+    squeeze = q.ndim == 3
+    if squeeze:
+        q, k, v = q[None], k[None], v[None]
+    b, h, t, d = q.shape
+    out = _flash(q.reshape(b * h, t, d), k.reshape(b * h, t, d),
+                 v.reshape(b * h, t, d), causal, block_q, interpret)
+    out = out.reshape(b, h, t, d)
+    return out[0] if squeeze else out

@@ -1,0 +1,188 @@
+"""Pallas flash attention (ops/pallas_attention.py): K-blocked online-
+softmax kernel vs the dense reference. Runs in interpreter mode on CPU,
+which emulates TPU MXU semantics (bf16 multiply passes for f32 dots) —
+tolerances are set for that, and gradients are exact because the
+backward recomputes through the jnp reference."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+from paddle_tpu.ops.pallas_attention import flash_attention, _reference
+
+# MXU-emulation tolerance (bf16 multiply passes inside the kernel dots)
+TOL = dict(rtol=2e-2, atol=2e-2)
+
+
+class TestFlashKernel:
+    def _data(self, b=1, h=2, t=1024, d=32, seed=0):
+        rs = np.random.RandomState(seed)
+        mk = lambda: jnp.asarray(rs.randn(b, h, t, d).astype("float32"))
+        return mk(), mk(), mk()
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_reference_multi_kblock(self, causal):
+        q, k, v = self._data(t=1024)  # bk=512 -> 2 k blocks
+        out = flash_attention(q, k, v, causal=causal, block_q=256)
+        ref = _reference(q.reshape(2, 1024, 32), k.reshape(2, 1024, 32),
+                         v.reshape(2, 1024, 32), causal
+                         ).reshape(out.shape)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   **TOL)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_match_reference_exactly(self, causal):
+        q, k, v = self._data(t=512)
+
+        def f(q, k, v):
+            return flash_attention(q, k, v, causal=causal,
+                                   block_q=256).sum()
+
+        def r(q, k, v):
+            return _reference(q.reshape(2, 512, 32),
+                              k.reshape(2, 512, 32),
+                              v.reshape(2, 512, 32), causal).sum()
+
+        gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a),
+                                       np.asarray(b).reshape(a.shape),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_ragged_length_falls_back_to_reference(self):
+        q, k, v = self._data(t=100)  # 100 % 512 != 0
+        out = flash_attention(q, k, v, causal=True)
+        ref = _reference(q.reshape(2, 100, 32), k.reshape(2, 100, 32),
+                         v.reshape(2, 100, 32), True).reshape(out.shape)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestFlashInMultiheadOp:
+    def test_flag_switches_path_and_agrees(self):
+        """multihead_attention with flash_attention flag on matches the
+        dense path within MXU-emulation tolerance, through the full
+        Program/Executor stack."""
+        B, T, H, D = 2, 512, 2, 32
+        rs = np.random.RandomState(3)
+        feed = {"q": rs.randn(B, T, H * D).astype("float32") * 0.3,
+                "k": rs.randn(B, T, H * D).astype("float32") * 0.3,
+                "v": rs.randn(B, T, H * D).astype("float32") * 0.3}
+
+        def run(flag):
+            ptpu.config.set_flags(flash_attention=flag)
+            try:
+                from paddle_tpu.layer_helper import LayerHelper
+                main, startup = ptpu.Program(), ptpu.Program()
+                with ptpu.program_guard(main, startup):
+                    q = layers.data("q", shape=[T, H * D])
+                    k = layers.data("k", shape=[T, H * D])
+                    v = layers.data("v", shape=[T, H * D])
+                    helper = LayerHelper("mha_test")
+                    out = helper.create_tmp_variable("float32")
+                    helper.append_op(
+                        type="multihead_attention",
+                        inputs={"Q": [q.name], "K": [k.name],
+                                "V": [v.name]},
+                        outputs={"Out": [out.name]},
+                        attrs={"num_heads": H, "causal": True})
+                exe = ptpu.Executor()
+                exe.run(startup)
+                got, = exe.run(main, feed=feed, fetch_list=[out])
+                return got
+            finally:
+                ptpu.config.set_flags(flash_attention=False)
+
+        with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+            dense = run(False)
+        with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+            flash = run(True)
+        np.testing.assert_allclose(flash, dense, **TOL)
+
+
+class TestBlockSelection:
+    def test_tileable_lengths_stay_on_the_kernel(self, monkeypatch):
+        """T=768 tiles with bk=384 — the dense fallback must NOT run."""
+        from paddle_tpu.ops import pallas_attention as pa
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.randn(1, 1, 768, 32).astype("float32"))
+
+        def boom(*a, **k):
+            raise AssertionError("dense fallback used for tileable T")
+
+        ref = pa._reference
+        monkeypatch.setattr(pa, "_reference", boom)
+        out = pa.flash_attention(q, q, q, causal=True)
+        monkeypatch.setattr(pa, "_reference", ref)
+        want = ref(q[0], q[0], q[0], True).reshape(out.shape)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   **TOL)
+
+    def test_chunked_backward_matches_dense_grads(self):
+        """The O(bq*T) chunked backward == dense reference grads."""
+        from paddle_tpu.ops import pallas_attention as pa
+        rs = np.random.RandomState(1)
+        q = jnp.asarray(rs.randn(1, 2, 768, 32).astype("float32"))
+        k = jnp.asarray(rs.randn(1, 2, 768, 32).astype("float32"))
+        v = jnp.asarray(rs.randn(1, 2, 768, 32).astype("float32"))
+
+        def f(q, k, v):
+            return (pa.flash_attention(q, k, v, causal=True) *
+                    jnp.arange(32)).sum()
+
+        def r(q, k, v):
+            return (pa._reference(
+                q.reshape(2, 768, 32), k.reshape(2, 768, 32),
+                v.reshape(2, 768, 32), True).reshape(q.shape) *
+                jnp.arange(32)).sum()
+
+        gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+
+def test_flash_flag_is_part_of_the_compile_cache_key():
+    """Flipping the flag between runs of the SAME program must retrace
+    (the flag is read at trace time)."""
+    from paddle_tpu.layer_helper import LayerHelper
+    from paddle_tpu.ops import pallas_attention as pa
+    calls = []
+    orig = pa.flash_attention
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        q = layers.data("q", shape=[256, 64])
+        helper = LayerHelper("mha_cache_test")
+        out = helper.create_tmp_variable("float32")
+        helper.append_op(type="multihead_attention",
+                         inputs={"Q": [q.name], "K": [q.name],
+                                 "V": [q.name]},
+                         outputs={"Out": [out.name]},
+                         attrs={"num_heads": 2, "causal": True})
+    exe = ptpu.Executor()
+    exe.run(startup)
+    feed = {"q": np.random.RandomState(0).randn(1, 256, 64).astype(
+        "float32")}
+    import paddle_tpu.ops.attention_ops  # noqa: F401
+    pa_mod = pa
+    try:
+        pa_mod.flash_attention = spy
+        exe.run(main, feed=feed, fetch_list=[out])   # flag off: dense
+        assert not calls
+        ptpu.config.set_flags(flash_attention=True)
+        exe.run(main, feed=feed, fetch_list=[out])   # must retrace
+        assert calls, "flag flip did not retrace the cached program"
+    finally:
+        pa_mod.flash_attention = orig
+        ptpu.config.set_flags(flash_attention=False)
