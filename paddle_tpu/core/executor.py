@@ -275,13 +275,14 @@ class Executor:
         check_nan_inf = bool(_config.get_flag("check_nan_inf"))
         amp = _config.get_flag("amp")
         flash = bool(_config.get_flag("flash_attention"))
+        precision = _config.get_flag("matmul_precision")
         feed_sig = tuple(sorted((n, tuple(a.shape), str(a.dtype))
                                 for n, a in feed_arrays.items()))
         # every trace-time flag must key the compile cache
         key = (program._uid, program._version, feed_sig, tuple(fetch_names),
                bool(donate_state),
                self.strategy._uid if self.strategy is not None else None,
-               check_nan_inf, amp, flash)
+               check_nan_inf, amp, flash, precision)
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._build(program, block, feed_sig, fetch_names,

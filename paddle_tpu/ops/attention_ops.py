@@ -40,8 +40,12 @@ def _multihead_attention(ctx):
         return {"Out": out.reshape(b, tq, dm)}
 
     from .. import config as _config
+    # flash kernel only outside a sharded trace: pallas_call is an
+    # opaque custom call GSPMD cannot partition (the ring path above is
+    # the sharded long-context answer)
     if _config.get_flag("flash_attention") and tq == tk and \
-            not ctx.has_input("KeyLength"):
+            not ctx.has_input("KeyLength") and \
+            parallel.current_strategy() is None:
         from .pallas_attention import flash_attention
         out = flash_attention(qh.transpose(0, 2, 1, 3),
                               kh.transpose(0, 2, 1, 3),

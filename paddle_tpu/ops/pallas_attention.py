@@ -88,13 +88,15 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
 
 def _block_size(t, cap):
-    """Largest divisor of t that is <= cap and >= 128 (or t itself when
-    shorter) — avoids silently falling back to the dense path for
-    tileable lengths like 768 or 1280."""
+    """Largest divisor of t that is <= cap, >= 128 and sublane-aligned
+    (multiple of 16 covers f32 and bf16 tiles) — avoids silently
+    falling back to the dense path for tileable lengths like 768 or
+    1280, while genuinely ragged lengths (e.g. 100) return 0 so the
+    caller uses the XLA reference instead of an unaligned kernel."""
     if t <= cap:
-        return t
+        return t if t % 16 == 0 else 0
     for b in range(cap, 127, -1):
-        if t % b == 0:
+        if t % b == 0 and b % 16 == 0:
             return b
     return 0
 

@@ -186,3 +186,106 @@ def test_flash_flag_is_part_of_the_compile_cache_key():
     finally:
         pa_mod.flash_attention = orig
         ptpu.config.set_flags(flash_attention=False)
+
+
+def test_transformer_lm_trains_with_flash_attention():
+    """The transformer LM trains under flash_attention=True and its
+    loss trajectory tracks the dense path (the kernels differ only by
+    MXU rounding)."""
+    from paddle_tpu.models import transformer
+
+    def run(flag):
+        ptpu.config.set_flags(flash_attention=flag)
+        try:
+            main, startup = ptpu.Program(), ptpu.Program()
+            main.random_seed = startup.random_seed = 21
+            with ptpu.program_guard(main, startup):
+                toks = layers.data("toks", shape=[128], dtype="int64")
+                lbls = layers.data("lbls", shape=[128], dtype="int64")
+                loss, _ = transformer.transformer_lm(
+                    toks, lbls, vocab_size=100, d_model=64,
+                    num_heads=2, d_ff=128, num_layers=2)
+                ptpu.optimizer.Adam(learning_rate=1e-3).minimize(
+                    loss, startup_program=startup)
+            exe = ptpu.Executor()
+            exe.run(startup)
+            rs = np.random.RandomState(0)
+            losses = []
+            for _ in range(15):
+                t = rs.randint(0, 100, (4, 128)).astype("int64")
+                feed = {"toks": t,
+                        "lbls": np.roll(t, -1, axis=1)}
+                out, = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(out))
+            return losses
+        finally:
+            ptpu.config.set_flags(flash_attention=False)
+
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        dense = run(False)
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        flash = run(True)
+    assert flash[-1] < flash[0]  # it trains
+    np.testing.assert_allclose(flash, dense, rtol=5e-2, atol=5e-2)
+
+
+def test_genuinely_ragged_length_uses_dense_fallback(monkeypatch):
+    """T=100 (not sublane-aligned) must route to the XLA reference."""
+    from paddle_tpu.ops import pallas_attention as pa
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 1, 100, 32).astype("float32"))
+    called = []
+    ref = pa._reference
+
+    def spy(*a, **k):
+        called.append(1)
+        return ref(*a, **k)
+
+    monkeypatch.setattr(pa, "_reference", spy)
+    out = pa.flash_attention(q, q, q, causal=True)
+    assert called, "ragged length did not use the dense fallback"
+    want = ref(q[0], q[0], q[0], True).reshape(out.shape)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_flash_disabled_under_distributed_strategy():
+    """With a mesh strategy active, the op must keep the partitionable
+    dense path even when the flash flag is on."""
+    from paddle_tpu.ops import pallas_attention as pa
+    from paddle_tpu import parallel
+    import paddle_tpu.ops.attention_ops  # noqa: F401
+
+    calls = []
+    orig = pa.flash_attention
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    mesh = ptpu.parallel.make_mesh({"data": 8})
+    strategy = ptpu.parallel.DistStrategy(mesh, data_axis="data")
+    from paddle_tpu.layer_helper import LayerHelper
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        q = layers.data("q", shape=[256, 64])
+        helper = LayerHelper("mha_dist_test")
+        out = helper.create_tmp_variable("float32")
+        helper.append_op(type="multihead_attention",
+                         inputs={"Q": [q.name], "K": [q.name],
+                                 "V": [q.name]},
+                         outputs={"Out": [out.name]},
+                         attrs={"num_heads": 2, "causal": True})
+    ptpu.config.set_flags(flash_attention=True)
+    try:
+        pa.flash_attention = spy
+        exe = ptpu.Executor(strategy=strategy)
+        exe.run(startup)
+        feed = {"q": np.random.RandomState(0).randn(8, 256, 64).astype(
+            "float32")}
+        got, = exe.run(main, feed=feed, fetch_list=[out])
+        assert not calls, "flash kernel ran inside a sharded trace"
+        assert np.isfinite(got).all()
+    finally:
+        pa.flash_attention = orig
+        ptpu.config.set_flags(flash_attention=False)
