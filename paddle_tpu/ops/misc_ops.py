@@ -1,4 +1,7 @@
-"""Misc ops: print (debug), roi_pool."""
+"""Misc ops: print (debug), roi_pool, and the gserver layer tail
+(switch_order, scale_shift, resize, kmax_seq_score, scale_sub_region —
+reference SwitchOrderLayer, ScaleShiftLayer.cpp, ResizeLayer.cpp,
+KmaxSeqScoreLayer.cpp, ScaleSubRegionLayer.cpp)."""
 
 import jax
 import jax.numpy as jnp
@@ -54,3 +57,85 @@ def _roi_pool(ctx):
 
     out = jax.vmap(pool_one)(rois.astype(jnp.float32))
     return {"Out": out, "Argmax": jnp.zeros(out.shape, dtype=jnp.int32)}
+
+
+@register_op("switch_order")
+def _switch_order(ctx):
+    """NCHW <-> NHWC layout switch (reference function/SwitchOp /
+    SwitchOrderLayer)."""
+    x = ctx.input("X")
+    if ctx.attr("to_nhwc", True):
+        return {"Out": jnp.transpose(x, (0, 2, 3, 1))}
+    return {"Out": jnp.transpose(x, (0, 3, 1, 2))}
+
+
+@register_op("scale_shift")
+def _scale_shift(ctx):
+    """y = w * x + b with trainable SCALAR w, b (reference
+    ScaleShiftLayer.cpp:21-34)."""
+    x = ctx.input("X")
+    w = ctx.input("Scale").reshape(())
+    out = x * w
+    if ctx.has_input("Bias"):
+        out = out + ctx.input("Bias").reshape(())
+    return {"Out": out}
+
+
+@register_op("resize")
+def _resize(ctx):
+    """Reshape rows to a new trailing size (reference ResizeLayer.cpp:
+    (H*W) must divide by size; output (H*W/size, size))."""
+    x = ctx.input("X")
+    size = ctx.attr("size")
+    return {"Out": x.reshape(-1, size)}
+
+
+@register_op("kmax_seq_score")
+def _kmax_seq_score(ctx):
+    """Top-k score INDICES per sequence over padded [B, T] scores
+    (reference KmaxSeqScoreLayer): padding masked to -inf; indices
+    past a sequence's k are -1."""
+    scores = ctx.input("X")
+    k = ctx.attr("beam_size")
+    if scores.ndim > 2:
+        scores = scores.reshape(scores.shape[0], -1)
+    b, t = scores.shape
+    kk = min(k, t)
+    if ctx.has_input("Length"):
+        length = ctx.input("Length").reshape(-1)
+        mask = jnp.arange(t)[None, :] < length[:, None]
+        # padding excluded from selection; validity comes from COUNTS
+        # (a genuine -inf score is still a valid entry)
+        scores = jnp.where(mask, scores, -jnp.inf)
+        n_valid = jnp.minimum(length, kk)
+    else:
+        n_valid = jnp.full((b,), kk)
+    _, idx = jax.lax.top_k(scores, kk)
+    valid = jnp.arange(kk)[None, :] < n_valid[:, None]
+    idx = jnp.where(valid, idx, -1).astype(jnp.int32)
+    if kk < k:  # fixed [B, beam_size] layout, -1 beyond T
+        idx = jnp.concatenate(
+            [idx, jnp.full((b, k - kk), -1, jnp.int32)], axis=1)
+    return {"Out": idx}
+
+
+@register_op("scale_sub_region")
+def _scale_sub_region(ctx):
+    """Scale a per-sample sub-region of NCHW input by ``value``
+    (reference ScaleSubRegionLayer / function/ScaleSubRegionOp).
+    Indices: [N, 6] 1-based inclusive (c1,c2,h1,h2,w1,w2) like the
+    reference's indices input."""
+    x = ctx.input("X")
+    ind = ctx.input("Indices").astype(jnp.int32)  # [N, 6]
+    value = ctx.attr("value", 1.0)
+    n, c, h, w = x.shape
+    ci = jnp.arange(c)[None, :, None, None]
+    hi = jnp.arange(h)[None, None, :, None]
+    wi = jnp.arange(w)[None, None, None, :]
+    sel = ((ci >= ind[:, 0, None, None, None] - 1) &
+           (ci <= ind[:, 1, None, None, None] - 1) &
+           (hi >= ind[:, 2, None, None, None] - 1) &
+           (hi <= ind[:, 3, None, None, None] - 1) &
+           (wi >= ind[:, 4, None, None, None] - 1) &
+           (wi <= ind[:, 5, None, None, None] - 1))
+    return {"Out": jnp.where(sel, x * value, x)}

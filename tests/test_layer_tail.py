@@ -1,0 +1,141 @@
+"""gserver layer tail (SURVEY A.2 remainder): switch_order,
+scale_shift, resize, kmax_seq_score, scale_sub_region."""
+
+import numpy as np
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+from paddle_tpu.core.backward import append_backward  # noqa: F401 (used below)
+
+
+def _run(build):
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        fetches, feed = build()
+    exe = ptpu.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetches)
+
+
+def test_switch_order_round_trip():
+    x = np.random.RandomState(0).randn(2, 3, 4, 5).astype("float32")
+
+    def build():
+        xv = layers.data("x", shape=[2, 3, 4, 5],
+                         append_batch_size=False)
+        nhwc = layers.switch_order(xv, to_nhwc=True)
+        back = layers.switch_order(nhwc, to_nhwc=False)
+        return [nhwc, back], {"x": x}
+
+    nhwc, back = _run(build)
+    np.testing.assert_allclose(nhwc, x.transpose(0, 2, 3, 1))
+    np.testing.assert_allclose(back, x)
+
+
+def test_scale_shift_trains_scalars():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[4])
+        out = layers.scale_shift(x)
+        loss = layers.mean(layers.square_error_cost(out, y))
+        ptpu.optimizer.SGD(learning_rate=0.2).minimize(
+            loss, startup_program=startup)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    for _ in range(120):
+        xv = rs.randn(16, 4).astype("float32")
+        yv = (3.0 * xv - 1.5).astype("float32")  # target w=3, b=-1.5
+        out_v, = exe.run(main, feed={"x": xv, "y": yv},
+                         fetch_list=[loss])
+    assert float(out_v) < 0.05, float(out_v)
+
+
+def test_resize_reshapes_rows():
+    x = np.arange(24, dtype="float32").reshape(2, 12)
+
+    def build():
+        xv = layers.data("x", shape=[2, 12], append_batch_size=False)
+        return [layers.resize(xv, 4)], {"x": x}
+
+    out, = _run(build)
+    np.testing.assert_allclose(out, x.reshape(6, 4))
+
+
+def test_kmax_seq_score_masks_padding():
+    scores = np.array([[0.1, 0.9, 0.5, 0.7],
+                       [0.8, 0.2, 0.0, 0.0]], dtype="float32")
+    length = np.array([4, 2], dtype="int64")
+
+    def build():
+        sv = layers.data("s", shape=[2, 4], append_batch_size=False)
+        lv = layers.data("len", shape=[2], dtype="int64",
+                         append_batch_size=False)
+        return [layers.kmax_seq_score(sv, length=lv, beam_size=3)], \
+            {"s": scores, "len": length}
+
+    idx, = _run(build)
+    np.testing.assert_array_equal(idx[0], [1, 3, 2])  # top-3 of row 0
+    np.testing.assert_array_equal(idx[1][:2], [0, 1])
+    assert idx[1][2] == -1  # only 2 valid entries in row 1
+
+
+def test_kmax_seq_score_fixed_width_and_neg_inf_scores():
+    """Output is always [B, beam_size] (-1 padded past T), and a
+    genuine -inf score stays a VALID entry (validity comes from
+    lengths, not finiteness)."""
+    scores = np.array([[-np.inf, 0.5, 0.1]], dtype="float32")
+    length = np.array([2], dtype="int64")
+
+    def build():
+        sv = layers.data("s", shape=[1, 3], append_batch_size=False)
+        lv = layers.data("len", shape=[1], dtype="int64",
+                         append_batch_size=False)
+        return [layers.kmax_seq_score(sv, length=lv, beam_size=5)], \
+            {"s": scores, "len": length}
+
+    idx, = _run(build)
+    assert idx.shape == (1, 5)  # fixed beam_size width
+    np.testing.assert_array_equal(idx[0], [1, 0, -1, -1, -1])
+
+
+def test_scale_sub_region_region_and_grad():
+    x = np.ones((1, 2, 4, 4), dtype="float32")
+    ind = np.array([[1, 1, 2, 3, 2, 3]], dtype="int64")  # c=1,h=2..3,w=2..3
+
+    def build():
+        xv = layers.data("x", shape=[1, 2, 4, 4],
+                         append_batch_size=False)
+        iv = layers.data("ind", shape=[1, 6], dtype="int64",
+                         append_batch_size=False)
+        out = layers.scale_sub_region(xv, iv, value=10.0)
+        return [out], {"x": x, "ind": ind}
+
+    out, = _run(build)
+    want = x.copy()
+    want[0, 0, 1:3, 1:3] = 10.0
+    np.testing.assert_allclose(out, want)
+
+    # gradient: in-region cotangents scaled by value, rest pass-through
+    # (reference ScaleSubRegionGrad semantics)
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        xv = main.global_block().create_parameter(
+            name="ssr_x", shape=[1, 2, 4, 4], dtype="float32",
+            initializer=ptpu.initializer.Constant(1.0))
+        sv = startup.global_block().create_var(
+            name="ssr_x", shape=[1, 2, 4, 4], dtype="float32",
+            persistable=True)
+        ptpu.initializer.Constant(1.0)(sv, startup.global_block())
+        iv = layers.data("ind", shape=[1, 6], dtype="int64",
+                         append_batch_size=False)
+        out2 = layers.scale_sub_region(xv, iv, value=10.0)
+        loss = layers.reduce_sum(out2)
+        append_backward(loss, parameter_list=["ssr_x"])
+    exe = ptpu.Executor()
+    exe.run(startup)
+    g, = exe.run(main, feed={"ind": ind}, fetch_list=["ssr_x@GRAD"])
+    gw = np.ones((1, 2, 4, 4), dtype="float32")
+    gw[0, 0, 1:3, 1:3] = 10.0
+    np.testing.assert_allclose(g, gw)
