@@ -23,7 +23,8 @@ __all__ = ["sequence_mask", "sequence_pool", "sequence_first_step",
            "lstm_unit", "sequence_reverse", "sequence_erase_pad",
            "sequence_slice", "sequence_concat", "nested_sequence_mask",
            "nested_sequence_pool", "sub_seq", "sub_nested_seq",
-           "nested_flatten", "nested_unflatten"]
+           "nested_flatten", "nested_unflatten", "sequence_reshape",
+           "lod_reset", "max_sequence_len"]
 
 
 def sequence_mask(length, maxlen, dtype="float32", **kwargs):
@@ -342,3 +343,52 @@ def nested_unflatten(input, batch, max_sub, **kwargs):
     from . import tensor as _tensor
     shape = list(input.shape)
     return _tensor.reshape(input, [batch, max_sub] + shape[1:], **kwargs)
+
+
+def sequence_reshape(input, new_dim, length=None, **kwargs):
+    """Change per-timestep width, scaling lengths (reference
+    sequence_reshape_op). Returns (out, new_length|None).
+    Caller contract (as in the reference's per-sequence enforce):
+    every valid length must satisfy (length * D) % new_dim == 0."""
+    helper = LayerHelper("sequence_reshape", **kwargs)
+    inputs = {"X": [input.name]}
+    out = helper.create_tmp_variable(input.dtype)
+    outputs = {"Out": [out.name]}
+    new_len = None
+    if length is not None:
+        inputs["Length"] = [length.name]
+        new_len = helper.create_tmp_variable(length.dtype,
+                                             stop_gradient=True)
+        outputs["OutLength"] = [new_len.name]
+    helper.append_op(type="sequence_reshape", inputs=inputs,
+                     outputs=outputs, attrs={"new_dim": new_dim})
+    return out, new_len
+
+
+def lod_reset(x, new_length, original_length=None, **kwargs):
+    """Re-declare a batch's sequence lengths (reference lod_reset_op).
+    Returns (x_passthrough, clipped_length). Pass ``original_length``
+    to also clip against the CURRENT valid lengths — without it, a
+    grown length exposes padding rows as data (the padded-batch hazard
+    the dense-rows reference does not have)."""
+    helper = LayerHelper("lod_reset", **kwargs)
+    out = helper.create_tmp_variable(x.dtype)
+    out_len = helper.create_tmp_variable(new_length.dtype,
+                                         stop_gradient=True)
+    inputs = {"X": [x.name], "Length": [new_length.name]}
+    if original_length is not None:
+        inputs["OrigLength"] = [original_length.name]
+    helper.append_op(type="lod_reset", inputs=inputs,
+                     outputs={"Out": [out.name],
+                              "OutLength": [out_len.name]})
+    return out, out_len
+
+
+def max_sequence_len(length, **kwargs):
+    """Max sequence length in the batch (max_sequence_len_op)."""
+    helper = LayerHelper("max_sequence_len", **kwargs)
+    out = helper.create_tmp_variable(length.dtype, stop_gradient=True)
+    helper.append_op(type="max_sequence_len",
+                     inputs={"Length": [length.name]},
+                     outputs={"Out": [out.name]})
+    return out

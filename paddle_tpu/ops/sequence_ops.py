@@ -303,3 +303,56 @@ def _lstm_unit(ctx):
     c_new = f * cp + i * jnp.tanh(gc)
     h_new = jax.nn.sigmoid(go) * jnp.tanh(c_new)
     return {"H": h_new, "C": c_new}
+
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ctx):
+    """Change the per-timestep width (reference sequence_reshape_op):
+    [B, T, D] + length -> [B, T*D/new_dim, new_dim] with lengths scaled
+    by D/new_dim (the LoD offsets scale the same way).
+
+    CONTRACT (same as the reference's per-sequence enforce,
+    sequence_reshape_op.cc: offset*D % new_dim == 0): every valid
+    length must satisfy (length * D) % new_dim == 0, or the scaled
+    OutLength floor-truncates and the boundary row mixes valid data
+    with padding. Lengths are traced values under jit, so this cannot
+    be checked data-dependently here — callers guarantee it."""
+    x = ctx.input("X")
+    new_dim = ctx.attr("new_dim")
+    b, t, d = x.shape
+    if (t * d) % new_dim:
+        raise ValueError("sequence_reshape: T*D=%d not divisible by "
+                         "new_dim=%d" % (t * d, new_dim))
+    out = x.reshape(b, (t * d) // new_dim, new_dim)
+    outs = {"Out": out}
+    if ctx.has_input("Length"):
+        length = ctx.input("Length").reshape(-1)
+        outs["OutLength"] = (length * d // new_dim).astype(length.dtype)
+    return outs
+
+
+@register_op("lod_reset")
+def _lod_reset(ctx):
+    """Replace a sequence batch's lengths (reference lod_reset_op: swap
+    the LoD leaving data untouched). Padded analog: pass data through
+    and emit the new length vector, clipped to the time axis AND (when
+    OrigLength is given) to the original valid lengths — in the
+    reference every row is dense real data, but here rows past the
+    original length are PADDING, so growing a length would silently
+    promote padding to data."""
+    x = ctx.input("X")
+    new_len = ctx.input("Length").reshape(-1)
+    t = x.shape[1] if x.ndim > 1 else x.shape[0]
+    out_len = jnp.clip(new_len, 0, t)
+    if ctx.has_input("OrigLength"):
+        orig = ctx.input("OrigLength").reshape(-1)
+        out_len = jnp.minimum(out_len, orig)
+    return {"Out": x, "OutLength": out_len.astype(new_len.dtype)}
+
+
+@register_op("max_sequence_len")
+def _max_sequence_len(ctx):
+    """Max length in the batch (reference max_sequence_len_op over the
+    LoD rank table)."""
+    length = ctx.input("Length").reshape(-1)
+    return {"Out": jnp.max(length).reshape(1)}

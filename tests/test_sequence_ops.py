@@ -200,3 +200,71 @@ class TestRNN:
         gi, gf, go, gc = np.split(x, 4, axis=1)
         c = sig(gf + 0.5) * c_prev + sig(gi) * np.tanh(gc)
         np.testing.assert_allclose(cc, c, rtol=1e-4, atol=1e-5)
+
+
+class TestSequenceReshapeFamily:
+    def test_sequence_reshape_scales_lengths(self):
+        import paddle_tpu as ptpu
+        from paddle_tpu import layers
+        x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+        length = np.array([3, 2], dtype="int64")
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            xv = layers.data("x", shape=[2, 3, 4],
+                             append_batch_size=False)
+            lv = layers.data("len", shape=[2], dtype="int64",
+                             append_batch_size=False)
+            out, nl = layers.sequence_reshape(xv, new_dim=2, length=lv)
+        exe = ptpu.Executor()
+        got, got_len = exe.run(main, feed={"x": x, "len": length},
+                               fetch_list=[out, nl])
+        np.testing.assert_allclose(got, x.reshape(2, 6, 2))
+        np.testing.assert_array_equal(got_len, [6, 4])  # len * 4/2
+
+    def test_lod_reset_and_max_sequence_len(self):
+        import paddle_tpu as ptpu
+        from paddle_tpu import layers
+        x = np.ones((2, 5, 3), dtype="float32")
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            xv = layers.data("x", shape=[2, 5, 3],
+                             append_batch_size=False)
+            lv = layers.data("len", shape=[2], dtype="int64",
+                             append_batch_size=False)
+            out, new_len = layers.lod_reset(xv, lv)
+            mx = layers.max_sequence_len(new_len)
+            pooled = layers.sequence_pool(out, "sum", length=new_len)
+        exe = ptpu.Executor()
+        got, gl, gm, gp = exe.run(
+            main, feed={"x": x, "len": np.array([9, 2], "int64")},
+            fetch_list=[out, new_len, mx, pooled])
+        np.testing.assert_allclose(got, x)
+        np.testing.assert_array_equal(gl, [5, 2])  # clipped to T
+        assert int(gm[0]) == 5
+        np.testing.assert_allclose(gp[1], np.full(3, 2.0))  # 2 rows
+
+    def test_lod_reset_clips_to_original_length(self):
+        """Growing a length must not expose padding when the original
+        lengths are provided."""
+        import paddle_tpu as ptpu
+        from paddle_tpu import layers
+        x = np.ones((1, 5, 2), dtype="float32")
+        x[0, 3:] = 99.0  # padding content that must stay invisible
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            xv = layers.data("x", shape=[1, 5, 2],
+                             append_batch_size=False)
+            nl = layers.data("nl", shape=[1], dtype="int64",
+                             append_batch_size=False)
+            ol = layers.data("ol", shape=[1], dtype="int64",
+                             append_batch_size=False)
+            out, new_len = layers.lod_reset(xv, nl, original_length=ol)
+            pooled = layers.sequence_pool(out, "average",
+                                          length=new_len)
+        exe = ptpu.Executor()
+        gl, gp = exe.run(main,
+                         feed={"x": x, "nl": np.array([5], "int64"),
+                               "ol": np.array([3], "int64")},
+                         fetch_list=[new_len, pooled])
+        np.testing.assert_array_equal(gl, [3])  # clipped to original
+        np.testing.assert_allclose(gp[0], [1.0, 1.0])  # padding unseen
