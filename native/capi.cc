@@ -124,17 +124,37 @@ int ptc_model_forward(void* model, const ptc_tensor* inputs, int n_inputs) {
   // r: [(name, float32 ndarray (buffer-protocol), shape list)].
   // Parse into locals; swap into the handle only on full success, so a
   // mid-parse failure leaves the previous forward's outputs intact and
-  // the name/buf/shape vectors never disagree in length.
+  // the name/buf/shape vectors never disagree in length. Every bridge
+  // access is checked — a malformed return yields an error code, never
+  // UB in the embedding process.
+  if (!PyList_Check(r)) {
+    Py_DECREF(r);
+    return -3;
+  }
   Py_ssize_t n_out = PyList_Size(r);
   std::vector<std::string> names;
   std::vector<std::vector<float>> bufs;
   std::vector<std::vector<int64_t>> shapes;
   for (Py_ssize_t i = 0; i < n_out; i++) {
     PyObject* item = PyList_GetItem(r, i);
+    if (item == nullptr || !PyTuple_Check(item) ||
+        PyTuple_Size(item) < 3) {
+      PyErr_Clear();
+      Py_DECREF(r);
+      return -3;
+    }
     PyObject* name = PyTuple_GetItem(item, 0);
     PyObject* arr = PyTuple_GetItem(item, 1);
     PyObject* shape = PyTuple_GetItem(item, 2);
-    names.push_back(PyUnicode_AsUTF8(name));
+    const char* name_c =
+        (name != nullptr) ? PyUnicode_AsUTF8(name) : nullptr;
+    if (name_c == nullptr || arr == nullptr || shape == nullptr ||
+        !PyList_Check(shape)) {
+      PyErr_Clear();
+      Py_DECREF(r);
+      return -3;
+    }
+    names.push_back(name_c);
     Py_buffer view;
     if (PyObject_GetBuffer(arr, &view, PyBUF_CONTIG_RO) != 0) {
       PyErr_Print();
@@ -147,8 +167,16 @@ int ptc_model_forward(void* model, const ptc_tensor* inputs, int n_inputs) {
     PyBuffer_Release(&view);
     Py_ssize_t nd = PyList_Size(shape);
     std::vector<int64_t> dims;
-    for (Py_ssize_t d = 0; d < nd; d++)
-      dims.push_back(PyLong_AsLongLong(PyList_GetItem(shape, d)));
+    for (Py_ssize_t d = 0; d < nd; d++) {
+      PyObject* dim = PyList_GetItem(shape, d);
+      long long v = (dim != nullptr) ? PyLong_AsLongLong(dim) : -1;
+      if (v == -1 && PyErr_Occurred()) {
+        PyErr_Clear();
+        Py_DECREF(r);
+        return -3;
+      }
+      dims.push_back(v);
+    }
     shapes.push_back(std::move(dims));
   }
   Py_DECREF(r);

@@ -52,7 +52,9 @@ def ssd_net(img, num_classes=21, gt_box=None, gt_label=None,
         pb, pv = layers.prior_box(feat, img,
                                   min_sizes=list(min_sizes[i]),
                                   aspect_ratios=list(aspect_ratios))
-        # priors per cell = len(min_sizes) * (1 + 2*len(aspect_ratios))
+        # priors per cell = len(min_sizes)*(1 + len(max_sizes)) plus the
+        # flip-expanded non-unit aspect-ratio boxes emitted once (see
+        # prior_box); read it off the op output rather than recomputing
         num_priors = pb.shape[2]
         loc, conf = _head(feat, num_priors, num_classes, "head%d" % i)
         locs.append(loc)

@@ -71,10 +71,15 @@ def _encode(gt, priors, variances):
 
 @register_op("prior_box")
 def _prior_box(ctx):
-    """SSD anchors for one feature map (PriorBox.cpp:95-150): per cell,
-    one box per min_size, sqrt(min*max) when max_sizes given, then
-    min*sqrt(ar) / min/sqrt(ar) per non-unit aspect ratio (with
-    reciprocals when flip)."""
+    """SSD anchors for one feature map, matching PriorBox.cpp:99-150's
+    per-cell emission order exactly (so heads trained against the
+    reference see priors in the same slots): for each min_size, the
+    (min, min) box then one sqrt(min*max) box per max_size; afterwards
+    the non-unit aspect-ratio boxes ONCE, sized by the LAST min_size
+    (the reference's ``minSize`` variable retains the final loop value
+    at PriorBox.cpp:131-139). ``flip`` appends the reciprocal of each
+    aspect ratio (PriorBox.cpp:69-73 always flips; the attr lets the
+    fluid-style caller disable it)."""
     feat = ctx.input("Input")          # [N, C, H, W]
     img = ctx.input("Image")           # [N, 3, IH, IW]
     min_sizes = [float(v) for v in ctx.attr("min_sizes")]
@@ -90,25 +95,26 @@ def _prior_box(ctx):
     step_h = ctx.attr("step_h", 0.0) or ih / h
     offset = ctx.attr("offset", 0.5)
 
-    ars = [1.0]
+    ars = []
     for ar in ars_attr:
-        if all(abs(ar - e) > 1e-6 for e in ars):
-            ars.append(ar)
-            if flip:
-                ars.append(1.0 / ar)
+        ars.append(ar)
+        if flip:
+            ars.append(1.0 / ar)
 
-    # per-cell (w, h) list, reference ordering: min, sqrt(min*max),
-    # then the non-unit aspect ratios of each min_size
+    # per-cell (w, h) list in the reference's emission order (see
+    # docstring): all (min, sqrt(min*max)...) groups, then aspect-ratio
+    # boxes once with the last min_size
     whs = []
-    for i, ms in enumerate(min_sizes):
+    for ms in min_sizes:
         whs.append((ms, ms))
-        if max_sizes:
-            s = math.sqrt(ms * max_sizes[i])
+        for mx in max_sizes:
+            s = math.sqrt(ms * mx)
             whs.append((s, s))
-        for ar in ars:
-            if abs(ar - 1.0) < 1e-6:
-                continue
-            whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+    last_ms = min_sizes[-1]
+    for ar in ars:
+        if abs(ar - 1.0) < 1e-6:
+            continue
+        whs.append((last_ms * math.sqrt(ar), last_ms / math.sqrt(ar)))
     num_priors = len(whs)
 
     cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w  # [W]

@@ -376,11 +376,13 @@ class ModelAverage:
         self._count_name = cname
         for p in params:
             sname = unique_name.generate("%s_avg_sum" % p.name)
+            # accumulate in f32 regardless of the parameter dtype: a
+            # bf16 running sum loses the window's low-order contributions
             var = block.create_var(name=sname, shape=list(p.shape),
-                                   dtype=p.dtype, persistable=True,
+                                   dtype="float32", persistable=True,
                                    stop_gradient=True)
             sv = sblock.create_var(name=sname, shape=list(p.shape),
-                                   dtype=p.dtype, persistable=True)
+                                   dtype="float32", persistable=True)
             ConstantInitializer(0.0)(sv, sblock)
             # runs after the optimizer's update of p in the same block
             block.append_op("elementwise_add",
@@ -413,7 +415,10 @@ class ModelAverage:
         for pname in self._param_names:
             self._backup[pname] = scope.find_var(pname)
             avg = np.asarray(scope.find_var(self._sums[pname])) / count
-            scope.set_var(pname, avg.astype(np.float32, copy=False))
+            # swap in with the parameter's own dtype so the compiled
+            # step's feed signature is unchanged on the next run
+            pdtype = np.asarray(scope.find_var(pname)).dtype
+            scope.set_var(pname, avg.astype(pdtype, copy=False))
 
     def restore(self, scope=None):
         from .core.scope import global_scope

@@ -12,9 +12,14 @@ thread
 3. dispatches ``jax.device_put`` (async H2D) and queues the ready feed,
 
 so host batch assembly and H2D transfer overlap the device step that the
-consumer is running. Arena blocks are recycled with a two-batch lag: by
-the time batch K+2 is staged, the step consuming batch K has been
-dispatched and device execution is serialized behind its transfer.
+consumer is running. Arena blocks are recycled with a two-batch lag AND
+only after the batch's device arrays report transfer-complete
+(``block_until_ready`` on the staged arrays) — the lag keeps the arena
+hot-path free of blocking in the steady state, the readiness barrier
+guarantees no block is returned to the allocator while an asynchronous
+H2D DMA may still be reading it. With ``device_put=False`` the arena is
+not used at all: the consumer would hold live views into arena memory,
+so plain (background-threaded) numpy copies are the staging path.
 
 Falls back to plain numpy copies (still background-threaded) if the
 native library is unavailable; ``arena_active`` reports which path is in
@@ -96,11 +101,17 @@ class StagedReader:
         self.arena_active = False
         self._arena = None
         self._active = None    # (thread, stop, queue) of a live fill
-        try:
-            self._arena = _Arena(int(capacity_mb) * (1 << 20))
-            self.arena_active = True
-        except Exception:
-            self._arena = None
+        # The arena only serves the device_put path: each block is read
+        # once by the H2D DMA and recycled after transfer-complete.
+        # Without device_put the consumer would hold live views INTO
+        # arena memory, making any recycle (or arena destroy) a silent
+        # corruption — plain numpy copies are the correct staging there.
+        if device_put:
+            try:
+                self._arena = _Arena(int(capacity_mb) * (1 << 20))
+                self.arena_active = True
+            except Exception:
+                self._arena = None
 
     # -- stats ----------------------------------------------------------
     def stats(self):
@@ -132,6 +143,17 @@ class StagedReader:
                 dst = jax.device_put(dst)
             staged[name] = dst
         return staged, ptrs
+
+    @staticmethod
+    def _wait_transfers(staged):
+        """Block until the batch's H2D transfers are done (device path).
+        numpy entries (device_put=False or fallback staging) pass
+        through — they have no in-flight DMA."""
+        import jax
+        arrays = [v for v in staged.values()
+                  if not isinstance(v, np.ndarray)]
+        if arrays:
+            jax.block_until_ready(arrays)
 
     def _fill(self, q, stop):
         try:
@@ -169,12 +191,18 @@ class StagedReader:
                 if isinstance(item, Exception):
                     raise item
                 staged, ptrs = item
-                # recycle arena blocks free_lag batches behind: their
-                # consuming steps are dispatched and device-serialized
-                pending.append(ptrs)
+                # recycle arena blocks free_lag batches behind, and only
+                # once the batch's own H2D transfers have completed — the
+                # lag keeps this non-blocking in steady state, the
+                # readiness barrier makes the free safe under
+                # backpressure (ptrs is empty when the arena is off).
+                pending.append((ptrs, staged))
                 while len(pending) > self.free_lag + 1:
-                    for p in pending.popleft():
-                        self._arena.free(p)
+                    old_ptrs, old_staged = pending.popleft()
+                    if old_ptrs:
+                        self._wait_transfers(old_staged)
+                        for p in old_ptrs:
+                            self._arena.free(p)
                 yield staged
         finally:
             self._shutdown(t, stop, q, pending)
@@ -189,7 +217,7 @@ class StagedReader:
             try:
                 item = q.get_nowait()
                 if isinstance(item, tuple):
-                    pending.append(item[1])
+                    pending.append((item[1], item[0]))
             except _queue.Empty:
                 pass
             t.join(timeout=0.05)
@@ -197,17 +225,17 @@ class StagedReader:
             while True:
                 item = q.get_nowait()
                 if isinstance(item, tuple):
-                    pending.append(item[1])
+                    pending.append((item[1], item[0]))
         except _queue.Empty:
             pass
         self._active = None
         if self._arena is not None:
-            import jax
-            try:  # best-effort: let in-flight transfers complete
-                jax.effects_barrier()
-            except Exception:
-                pass
-            for ptrs in pending:
+            for ptrs, staged in pending:
+                if ptrs and staged is not None:
+                    try:  # transfer-completion barrier before recycling
+                        self._wait_transfers(staged)
+                    except Exception:
+                        pass
                 for p in ptrs:
                     self._arena.free(p)
 
@@ -218,6 +246,14 @@ class StagedReader:
             t, stop, q = self._active
             self._shutdown(t, stop, q, collections.deque())
         if self._arena is not None:
+            import jax
+            try:
+                # a suspended generator frame may still hold batches in
+                # its local pending deque, unreachable from here; their
+                # device_put DMAs must finish before the arena unmaps
+                jax.effects_barrier()
+            except Exception:
+                pass
             self._arena.destroy()
             self._arena = None
             self.arena_active = False

@@ -15,12 +15,22 @@ __all__ = ["data", "fc", "embedding", "pooling", "concat",
            "cross_entropy_cost", "lstmemory_group", "gru_group",
            "max_id", "dropout", "img_conv", "img_pool", "batch_norm"]
 
-# var name -> (InputType, length var or None); the v2 feeding table
-_INPUT_TYPES = {}
+def _input_types(program=None):
+    """var name -> (InputType, length var) feeding table, scoped to the
+    owning program (a module-level global keyed by user-chosen names
+    would leak stale entries across topologies that reuse a name, e.g.
+    two models both calling their input 'pixel')."""
+    from ..core.framework import default_main_program
+    prog = program or default_main_program()
+    table = getattr(prog, "_v2_input_types", None)
+    if table is None:
+        table = prog._v2_input_types = {}
+    return table
 
 
 def _length_of(var):
-    entry = _INPUT_TYPES.get(getattr(var, "_v2_source", None) or var.name)
+    entry = _input_types().get(
+        getattr(var, "_v2_source", None) or var.name)
     return entry[1] if entry else getattr(var, "_v2_length", None)
 
 
@@ -39,11 +49,11 @@ def data(name, type, **kwargs):
         length = _L.data(name + "@len", shape=[], dtype="int64",
                          **kwargs)
         var._v2_length = length
-        _INPUT_TYPES[var.name] = (type, length)
+        _input_types()[var.name] = (type, length)
         return var
     shape = [type.dim] if type.dtype == "float32" else [1]
     var = _L.data(name, shape=shape, dtype=type.dtype, **kwargs)
-    _INPUT_TYPES[var.name] = (type, None)
+    _input_types()[var.name] = (type, None)
     return var
 
 
@@ -63,7 +73,7 @@ def fc(input, size, act=None, param_attr=None, bias_attr=None, **kwargs):
 
 
 def embedding(input, size, param_attr=None, **kwargs):
-    entry = _INPUT_TYPES.get(input.name)
+    entry = _input_types().get(input.name)
     vocab = entry[0].dim if entry else None
     if vocab is None:
         raise ValueError("embedding needs a data layer with "

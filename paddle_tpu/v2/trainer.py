@@ -16,7 +16,7 @@ from . import layer as _v2layer
 __all__ = ["SGD"]
 
 
-def _build_feeder(feeding, sample_width):
+def _build_feeder(feeding, sample_width, program=None):
     """DataFeeder from the v2 feeding map + registered input types."""
     if feeding is None:
         raise ValueError("v2 SGD needs feeding={layer_name: index}")
@@ -26,7 +26,7 @@ def _build_feeder(feeding, sample_width):
                          "fields" % (len(order), sample_width))
     feed_list = []
     for name, _ in order:
-        entry = _v2layer._INPUT_TYPES.get(name)
+        entry = _v2layer._input_types(program).get(name)
         if entry is None:
             raise KeyError("unknown data layer %r in feeding" % name)
         typ, length = entry
@@ -51,7 +51,7 @@ class SGD:
     def train(self, reader, num_passes=1, event_handler=None,
               feeding=None):
         sample = next(iter(reader()))[0]
-        feeder = _build_feeder(feeding, len(sample))
+        feeder = _build_feeder(feeding, len(sample), self._main)
         if self._trainer is None:
             self._trainer = _FluidTrainer(
                 self._cost, feeder=feeder, main_program=self._main,
@@ -64,7 +64,7 @@ class SGD:
     def test(self, reader, feeding=None):
         """Mean cost over a test reader (v2 SGD.test)."""
         sample = next(iter(reader()))[0]
-        feeder = _build_feeder(feeding, len(sample))
+        feeder = _build_feeder(feeding, len(sample), self._main)
         if self._trainer is None:
             self._trainer = _FluidTrainer(
                 self._cost, feeder=feeder, main_program=self._main,

@@ -42,7 +42,7 @@ def _feed_reader(n_batches, batch=4, dim=3, seed=0):
 @needs_native
 def test_arena_is_on_the_hot_path_and_recycles():
     sr = StagedReader(_feed_reader(6), depth=2, capacity_mb=4,
-                      device_put=False)
+                      device_put=True)
     assert sr.arena_active
     feeds = list(sr())
     assert len(feeds) == 6
@@ -58,7 +58,7 @@ def test_staged_values_match_source():
     """Arena copies + recycle lag must never corrupt a batch."""
     src = list(_feed_reader(5)())
     sr = StagedReader(_feed_reader(5), depth=2, capacity_mb=4,
-                      device_put=False, free_lag=0)  # hardest recycle
+                      device_put=True, free_lag=0)  # hardest recycle
     for got, want in zip(sr(), src):
         np.testing.assert_array_equal(np.asarray(got["x"]), want["x"])
         np.testing.assert_array_equal(np.asarray(got["y"]), want["y"])
@@ -108,7 +108,7 @@ def test_staging_overlaps_consumer_steps():
             yield b
 
     sr = StagedReader(slow_reader, depth=2, capacity_mb=16,
-                      device_put=False)
+                      device_put=True)
     steps = []
     for feed in sr():
         t0 = time.perf_counter()
@@ -131,7 +131,7 @@ def test_abandoned_generator_close_is_safe():
             yield b
 
     sr = StagedReader(slow_reader, depth=2, capacity_mb=4,
-                      device_put=False)
+                      device_put=True)
     gen = sr()
     next(gen)  # producer running, queue filling
     # abandon mid-pass (the Trainer.train finally path)
