@@ -248,8 +248,9 @@ class Executor:
         self.strategy = strategy
         self._cache = {}
 
-    def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True, donate_state=True):
+    def _prepare(self, program, feed, fetch_list, scope, donate_state):
+        """Shared run/lower prep: compile-cache lookup + state assembly.
+        Returns (fn, state_rw, state_ro, feed_arrays)."""
         if program is None:
             program = default_main_program()
         if not isinstance(program, Program):
@@ -316,6 +317,25 @@ class Executor:
                         for n, a in state_rw.items()}
             state_ro = {n: self.strategy.shard_state(n, a)
                         for n, a in state_ro.items()}
+        return fn, state_rw, state_ro, feed_arrays
+
+    def lower(self, program=None, feed=None, fetch_list=None, scope=None,
+              donate_state=True):
+        """AOT-lower the EXACT computation ``run`` would execute (same
+        donation, amp policy, state threading) without running it.
+        Returns the ``jax.stages.Lowered`` — ``.compile()`` then
+        ``.cost_analysis()`` / ``.as_text()`` for profiling and
+        compile-checks of the true step module."""
+        fn, state_rw, state_ro, feed_arrays = self._prepare(
+            program, feed, fetch_list, scope, donate_state)
+        return fn.lower(state_rw, state_ro, feed_arrays)
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, donate_state=True):
+        if scope is None:
+            scope = global_scope()
+        fn, state_rw, state_ro, feed_arrays = self._prepare(
+            program, feed, fetch_list, scope, donate_state)
 
         new_state, fetches, guards = fn(state_rw, state_ro, feed_arrays)
         for n, v in new_state.items():

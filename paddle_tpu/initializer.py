@@ -10,7 +10,8 @@ import numpy as np
 
 __all__ = ["Constant", "Uniform", "Normal", "Xavier", "MSRA",
            "ConstantInitializer", "UniformInitializer", "NormalInitializer",
-           "XavierInitializer", "MSRAInitializer"]
+           "XavierInitializer", "MSRAInitializer",
+           "NumpyArrayInitializer"]
 
 
 class Initializer:
@@ -100,6 +101,22 @@ class MSRAInitializer(Initializer):
         else:
             std = float(np.sqrt(2.0 / fi))
             NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+class NumpyArrayInitializer(Initializer):
+    """Initialize from a literal array (reference fluid
+    NumpyArrayInitializer / assign_value_op)."""
+
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block):
+        block.append_op(
+            "assign_value", outputs={"Out": [var.name]},
+            attrs={"shape": list(self.value.shape),
+                   "dtype": var.dtype,
+                   "values": self.value.ravel().tolist()},
+            infer_shape=False)
 
 
 Constant = ConstantInitializer

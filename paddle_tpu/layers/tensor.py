@@ -5,7 +5,7 @@ from ..core.framework import convert_dtype
 
 __all__ = ["create_tensor", "cast", "concat", "sums", "assign",
            "fill_constant", "fill_constant_batch_size_like", "ones", "zeros",
-           "reshape", "transpose", "split", "expand", "gather", "scatter",
+           "reshape", "transpose", "flip", "split", "expand", "gather", "scatter",
            "pad", "crop", "sequence_reshape_noop", "argmax", "argmin",
            "stack", "slice", "shape", "increment", "multiplex",
            "array_write", "array_read", "create_array"]
@@ -100,6 +100,11 @@ def reshape(x, shape, **kwargs):
 def transpose(x, perm, **kwargs):
     helper = LayerHelper("transpose", **kwargs)
     return _unary(helper, "transpose", x, {"axis": list(perm)})
+
+
+def flip(x, axis, **kwargs):
+    helper = LayerHelper("flip", **kwargs)
+    return _unary(helper, "flip", x, {"axis": int(axis)})
 
 
 def split(input, num_or_sections, dim=0, **kwargs):

@@ -23,4 +23,5 @@ from . import (  # noqa: F401
     sparse_ops,
     detection_ops,
     misc_ops,
+    legacy_tail_ops,
 )

@@ -1,0 +1,413 @@
+"""gserver layer tail: the last reference legacy layers without analogs.
+
+bilinear_interp (``paddle/gserver/layers/BilinearInterpLayer.cpp``),
+selective_fc (``SelectiveFullyConnectedLayer.cpp``), data_norm
+(``DataNormLayer.cpp``), mdlstm (``MDLstmLayer.cpp``), lambda_cost
+(``CostLayer.cpp:345-440`` LambdaCost), cross_entropy_over_beam
+(``CrossEntropyOverBeam.cpp``).
+
+TPU-first notes: selective_fc computes only the selected output columns
+by gathering weight columns (the sparse-compute capability of the
+reference's CpuSparseMatrix interOutput_) — no [B, V] dense product is
+formed; mdlstm is a wavefront of two nested ``lax.scan``s (row scan
+carrying a column carry) rather than per-cell kernel launches;
+cross_entropy_over_beam is pure gather + softmax, so the reference's
+hand-written backward (softmax CE scattered over beam paths) falls out
+of autodiff.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx):
+    """Corner-aligned bilinear resize of NCHW maps
+    (BilinearInterpLayer.cpp: ratio = (in-1)/(out-1))."""
+    x = ctx.input("X")  # [N, C, H, W]
+    out_h = ctx.attr("out_h")
+    out_w = ctx.attr("out_w")
+    n, c, h, w = x.shape
+    dt = x.dtype
+
+    def axis_weights(in_dim, out_dim):
+        if out_dim > 1:
+            ratio = (in_dim - 1.0) / (out_dim - 1.0)
+        else:
+            ratio = 0.0
+        pos = jnp.arange(out_dim, dtype=jnp.float32) * ratio
+        lo = jnp.floor(pos).astype(jnp.int32)
+        lo = jnp.minimum(lo, in_dim - 1)
+        hi = jnp.minimum(lo + 1, in_dim - 1)
+        frac = (pos - lo.astype(jnp.float32)).astype(dt)
+        return lo, hi, frac
+
+    y0, y1, fy = axis_weights(h, out_h)
+    x0, x1, fx = axis_weights(w, out_w)
+    # gather rows then columns; weights broadcast over [N, C]
+    top = x[:, :, y0, :] * (1 - fy)[None, None, :, None] \
+        + x[:, :, y1, :] * fy[None, None, :, None]      # [N,C,out_h,W]
+    out = top[:, :, :, x0] * (1 - fx) + top[:, :, :, x1] * fx
+    return {"Out": out}
+
+
+@register_op("selective_fc")
+def _selective_fc(ctx):
+    """FC that computes ONLY the selected output columns
+    (SelectiveFullyConnectedLayer.cpp): out[b, k] = x[b] . W[:, sel[b,k]]
+    + bias[sel[b,k]]. Sel is [B, K] int ids, -1 = padding (output 0).
+    Without Sel (the reference's fullOutput_ path) it is a plain fc.
+    The gather's transpose is a scatter-add onto the selected columns
+    only — the sparse-update semantics of the reference's sparse
+    interOutGrad_."""
+    x = ctx.input("X")            # [B, D]
+    w = ctx.input("W")            # [D, V]
+    bias = ctx.input("Bias") if ctx.has_input("Bias") else None
+    if not ctx.has_input("Sel"):
+        out = x @ w
+        if bias is not None:
+            out = out + bias
+        return {"Out": out}
+    sel = ctx.input("Sel")        # [B, K] int, -1 pad
+    valid = sel >= 0
+    ids = jnp.where(valid, sel, 0)
+    wsel = jnp.take(w.T, ids, axis=0)       # [B, K, D]
+    out = jnp.einsum("bd,bkd->bk", x, wsel)
+    if bias is not None:
+        out = out + jnp.take(bias, ids)
+    out = jnp.where(valid, out, jnp.zeros((), out.dtype))
+    return {"Out": out}
+
+
+@register_op("data_norm")
+def _data_norm(ctx):
+    """Per-feature data normalization (DataNormLayer.cpp):
+    z-score y=(x-mean)/std, min-max y=(x-min)/(max-min), or
+    decimal-scaling y=x/10^j. The stats are inputs (the layer wrapper
+    holds them as non-trainable persistable vars, the analog of the
+    reference's static data-meta parameter)."""
+    x = ctx.input("X")
+    mode = ctx.attr("mode", "z-score")
+    eps = 1e-8
+    if mode == "z-score":
+        mean, std = ctx.input("Mean"), ctx.input("Std")
+        return {"Out": (x - mean) / jnp.maximum(std, eps)}
+    if mode == "min-max":
+        mn, mx = ctx.input("Min"), ctx.input("Max")
+        return {"Out": (x - mn) / jnp.maximum(mx - mn, eps)}
+    if mode == "decimal-scaling":
+        mx = ctx.input("Max")  # max |x| per feature
+        j = jnp.ceil(jnp.log10(jnp.maximum(mx, eps)))
+        return {"Out": x / jnp.power(10.0, jnp.maximum(j, 0.0))}
+    raise ValueError("data_norm mode must be z-score | min-max | "
+                     "decimal-scaling, got %r" % mode)
+
+
+@register_op("mdlstm")
+def _mdlstm(ctx):
+    """2-D multi-dimensional LSTM (MDLstmLayer.cpp) over an NHWC grid.
+
+    Recurrence per cell (i, j), D=2 predecessors p in {(i-1,j),(i,j-1)}:
+      gates  = x.Wx + b + sum_p h[p].Wh            (shared Wh, as the
+                                                    reference's single
+                                                    weight_)
+      ig     = sigm(gates.ig + sum_p c[p]*peep_ig)
+      fg_p   = sigm(gates.fg_p + c[p]*peep_fg_p)   (one forget gate per
+                                                    direction)
+      cell   = tanh(gates.cell)
+      c      = sum_p fg_p*c[p] + ig*cell
+      og     = sigm(gates.og + c*peep_og)
+      h      = tanh(c)*og
+    Gate layout along the feature axis: [ig, fg_0, fg_1, og, cell]
+    (nb each; the reference's in-buffer order is an implementation
+    detail of its Matrix views — no weight porting for this layer).
+    directions[d]=False flips that axis (the reference's CoordIterator
+    start-corner choice).
+    """
+    gates_x = ctx.input("GatesX")   # [B, H, W, 5*nb]: x.Wx + b
+    wh = ctx.input("WeightH")       # [nb, 5*nb]
+    peep = ctx.input("Peephole")    # [4*nb]: ig, fg0, fg1, og
+    nb = wh.shape[0]
+    directions = ctx.attr("directions", (True, True))
+    b, h, w, _ = gates_x.shape
+
+    gx = gates_x
+    if not directions[0]:
+        gx = gx[:, ::-1]
+    if not directions[1]:
+        gx = gx[:, :, ::-1]
+
+    p_ig, p_fg0, p_fg1, p_og = (peep[i * nb:(i + 1) * nb]
+                                for i in range(4))
+
+    def cell_step(carry_col, inputs):
+        """One cell: carry_col = (c_left, h_left); inputs = per-column
+        (gates_x_cell [B,5nb], c_up [B,nb], h_up [B,nb])."""
+        c_left, h_left = carry_col
+        g_cell, c_up, h_up = inputs
+        g = g_cell + h_left @ wh + h_up @ wh
+        ig = jax.nn.sigmoid(g[:, :nb] + (c_up + c_left) * p_ig)
+        fg0 = jax.nn.sigmoid(g[:, nb:2 * nb] + c_up * p_fg0)
+        fg1 = jax.nn.sigmoid(g[:, 2 * nb:3 * nb] + c_left * p_fg1)
+        cell = jnp.tanh(g[:, 4 * nb:])
+        c = fg0 * c_up + fg1 * c_left + ig * cell
+        og = jax.nn.sigmoid(g[:, 3 * nb:4 * nb] + c * p_og)
+        hh = jnp.tanh(c) * og
+        return (c, hh), (c, hh)
+
+    def row_step(carry_row, row_inputs):
+        """One row: carry_row = (c_prev_row, h_prev_row) [W, B, nb];
+        scan cells left-to-right within the row."""
+        c_up_row, h_up_row = carry_row
+        g_row = row_inputs                     # [W, B, 5nb]
+        zeros = jnp.zeros((b, nb), gx.dtype)
+        (_, _), (c_row, h_row) = jax.lax.scan(
+            cell_step, (zeros, zeros), (g_row, c_up_row, h_up_row))
+        return (c_row, h_row), h_row
+
+    g_rows = jnp.transpose(gx, (1, 2, 0, 3))   # [H, W, B, 5nb]
+    zeros_row = jnp.zeros((w, b, nb), gx.dtype)
+    _, h_out = jax.lax.scan(row_step, (zeros_row, zeros_row), g_rows)
+    out = jnp.transpose(h_out, (2, 0, 1, 3))   # [B, H, W, nb]
+    if not directions[0]:
+        out = out[:, ::-1]
+    if not directions[1]:
+        out = out[:, :, ::-1]
+    return {"Out": out}
+
+
+def _ndcg(rank_scores, true_scores, valid, k):
+    """DCG@k of true_scores ordered by rank_scores desc / ideal DCG@k.
+    Padded positions (valid=False) sort last and weigh 0."""
+    L = rank_scores.shape[-1]
+    big = jnp.finfo(jnp.float32).max
+    # stable descending (ties keep original order; invalid sort last)
+    order = jnp.argsort(jnp.where(valid, -rank_scores, big))
+    picked = jnp.take_along_axis(true_scores, order, axis=-1)
+    pvalid = jnp.take_along_axis(valid, order, axis=-1)
+    pos = jnp.arange(L, dtype=jnp.float32)
+    wt = jnp.where((pos < k) & pvalid, 1.0 / jnp.log(pos + 2.0), 0.0)
+    dcg = jnp.sum((jnp.power(2.0, picked) - 1.0) * wt, axis=-1)
+    ideal = jnp.sort(jnp.where(valid, true_scores, -big))[..., ::-1]
+    ivalid = jnp.sort(jnp.where(valid, 1.0, 0.0))[..., ::-1] > 0
+    iwt = jnp.where((pos < k) & ivalid, 1.0 / jnp.log(pos + 2.0), 0.0)
+    max_dcg = jnp.sum((jnp.power(2.0, ideal) - 1.0) * iwt, axis=-1)
+    return dcg / jnp.maximum(max_dcg, 1e-12)
+
+
+def _lambda_grads(out_scores, true_scores, valid, k):
+    """LambdaRank pseudo-gradients (CostLayer.cpp LambdaCost::calcGrad),
+    full-sort semantics (max_sort_size=-1; the reference's partial sort
+    is a CPU cost optimization, not a semantic difference — documented).
+    Pairs (i, j) run over positions sorted by TRUE score descending;
+    lambda_ij = -|dcgDif| / (1 + exp(out_i - out_j)), scattered back."""
+    L = out_scores.shape[-1]
+    big = jnp.finfo(jnp.float32).max
+    # stable descending by TRUE score (ties keep original order, like
+    # the reference's pre-sorted scorePair_ iteration)
+    order = jnp.argsort(jnp.where(valid, -true_scores, big))
+    s = jnp.take_along_axis(true_scores, order, axis=-1)   # sorted labels
+    o = jnp.take_along_axis(out_scores, order, axis=-1)
+    v = jnp.take_along_axis(valid, order, axis=-1)
+    pos = jnp.arange(L, dtype=jnp.float32)
+    inv_log = 1.0 / jnp.log(pos + 2.0)
+    # maxDCG over the top-k *label*-sorted prefix (reference calcGrad)
+    wt = jnp.where((pos < k) & v, inv_log, 0.0)
+    max_dcg = jnp.sum((jnp.power(2.0, s) - 1.0) * wt, axis=-1,
+                      keepdims=True)
+    max_dcg = jnp.maximum(max_dcg, 1e-12)
+    gain = jnp.power(2.0, s)
+    dcg_dif = (gain[..., :, None] - gain[..., None, :]) * \
+        (inv_log[:, None] - inv_log[None, :])
+    lam = -jnp.abs(dcg_dif) / (1.0 + jnp.exp(o[..., :, None]
+                                             - o[..., None, :]))
+    pair = (pos[:, None] < pos[None, :]) & v[..., :, None] & \
+        v[..., None, :]
+    lam = jnp.where(pair, lam, 0.0) / max_dcg[..., None]
+    g_sorted = jnp.sum(lam, axis=-1) - jnp.sum(lam, axis=-2)
+    # scatter back to original positions
+    inv = jnp.argsort(order, axis=-1)
+    return jnp.take_along_axis(g_sorted, inv, axis=-1)
+
+
+@jax.custom_vjp
+def _lambda_cost_fn(out_scores, true_scores, valid, k):
+    ndcg = _ndcg(out_scores, true_scores, valid, k)
+    return jnp.where(valid, ndcg[..., None], 0.0)
+
+
+def _lambda_cost_fwd(out_scores, true_scores, valid, k):
+    return (_lambda_cost_fn(out_scores, true_scores, valid, k),
+            (out_scores, true_scores, valid, k))
+
+
+def _lambda_cost_bwd(res, ct):
+    out_scores, true_scores, valid, k = res
+    grads = _lambda_grads(out_scores, true_scores, valid, k)
+    # the reference's CostLayer applies calcGrad per unit output
+    # cotangent; scale by the mean cotangent over the sequence's valid
+    # elements (sum-reduced losses recover the reference scale)
+    denom = jnp.maximum(jnp.sum(valid, axis=-1, keepdims=True), 1)
+    g_seq = jnp.sum(jnp.where(valid, ct, 0.0), axis=-1,
+                    keepdims=True) / denom
+    return (grads * g_seq, None, None, None)
+
+
+_lambda_cost_fn.defvjp(_lambda_cost_fwd, _lambda_cost_bwd)
+
+
+@register_op("lambda_cost")
+def _lambda_cost(ctx):
+    """LambdaRank cost (CostLayer.cpp:345 LambdaCost): forward emits the
+    list's NDCG@k (computed from Score ranked by the model Output)
+    broadcast over the list's valid positions; backward injects the
+    hand-derived lambda pseudo-gradients into Output's grad (NDCG is not
+    differentiated — LambdaRank's defining trick). Padded layout:
+    Output/Score [B, L] + Length [B] replace the reference's
+    sequenceStartPositions."""
+    out_scores = ctx.input("X").astype(jnp.float32)
+    true_scores = ctx.input("Score").astype(jnp.float32)
+    length = ctx.input("Length")
+    k = int(ctx.attr("NDCG_num", 5))
+    L = out_scores.shape[-1]
+    valid = jnp.arange(L)[None, :] < length[:, None]
+    cost = _lambda_cost_fn(out_scores, true_scores, valid, k)
+    return {"Out": cost}
+
+
+@register_op("cross_entropy_over_beam")
+def _cross_entropy_over_beam(ctx):
+    """Globally-normalized CE over multi-step beam expansions
+    (CrossEntropyOverBeam.cpp). Per expansion step e the padded analogs
+    of the reference's nested-LoD triples:
+
+      Scores_e [B, S_e] — flat candidate scores at step e;
+      Ids_e    [B, R_e, W] — absolute indices into Scores_e of the W
+               beam picks per surviving row (-1 = pruned/padding). Row
+               r at step e+1 descends from the r-th VALID pick (row
+               -major) at step e — the reference's row bookkeeping
+               (CrossEntropyOverBeam.cpp:19-44);
+      Gold_e   [B] — absolute gold index into Scores_e.
+
+    A path is each valid pick at the LAST step where gold was still on
+    the beam; its score is the sum of its per-step pick scores along
+    the parent chain. If gold fell off, the gold chain joins as an
+    extra path (goldAsExtraPath_). Cost = -log softmax(path scores)
+    [gold]. Autodiff reproduces the reference's hand backward (softmax
+    CE scattered through the gathers)."""
+    E = len(ctx.inputs("Scores"))
+    scores = [ctx.inputs("Scores")[e] for e in range(E)]
+    ids = [ctx.inputs("Ids")[e] for e in range(E)]
+    gold = [ctx.inputs("Gold")[e] for e in range(E)]
+    B = scores[0].shape[0]
+    NEG = -1e9
+
+    # flatten each step's picks row-major: [B, P_e], P_e = R_e * W
+    flat_ids = [i.reshape(B, -1) for i in ids]
+    valid = [f >= 0 for f in flat_ids]
+    # rank of each valid pick among the step's valid picks = the row it
+    # becomes at the next step
+    ranks = [jnp.cumsum(v.astype(jnp.int32), axis=-1) - 1 for v in valid]
+    W = ids[0].shape[-1]
+
+    # gold tracking: gold_row[e] (row containing gold), found[e]
+    gold_row = jnp.zeros((B,), jnp.int32)
+    on_beam = jnp.ones((B,), bool)        # gold survived through e-1
+    # per step: is gold among step-e picks of its row, and its flat pos
+    gold_flat_pos, gold_found, gold_alive = [], [], []
+    for e in range(E):
+        row_ids = jnp.take_along_axis(
+            flat_ids[e], gold_row[:, None] * W + jnp.arange(W)[None, :],
+            axis=-1)                       # [B, W] picks of gold's row
+        hit = row_ids == gold[e][:, None]
+        found = hit.any(axis=-1) & on_beam
+        col = jnp.argmax(hit, axis=-1)
+        fpos = gold_row * W + col          # flat position of gold pick
+        gold_flat_pos.append(jnp.where(found, fpos, 0))
+        gold_found.append(found)
+        gold_alive.append(on_beam)
+        # next row = rank of gold's pick among valid picks at step e
+        gold_row = jnp.where(
+            found,
+            jnp.take_along_axis(ranks[e], fpos[:, None],
+                                axis=-1)[:, 0], 0)
+        on_beam = found
+
+    # last valid expansion per sequence: the first step where gold is
+    # missing, else E-1 (validExpansionCount_-1)
+    fell = jnp.stack([(~f) & a for f, a in
+                      zip(gold_found, gold_alive)], axis=-1)  # [B, E]
+    any_fell = fell.any(axis=-1)
+    lv = jnp.where(any_fell, jnp.argmax(fell, axis=-1), E - 1)
+
+    # accumulate each flat pick's path score per step: path_score[e] =
+    # own pick score + parent's path score at e-1 (parent row = rank)
+    P = max(f.shape[1] for f in flat_ids)
+
+    def pad_to(x, fill):
+        return jnp.pad(x, ((0, 0), (0, P - x.shape[1])),
+                       constant_values=fill)
+
+    path_scores, path_valids = [], []
+    prev_acc = jnp.zeros((B, P), jnp.float32)
+    for e in range(E):
+        pick = jnp.take_along_axis(
+            scores[e], jnp.maximum(flat_ids[e], 0), axis=-1)
+        pick = jnp.where(valid[e], pick.astype(jnp.float32), 0.0)
+        parent_row = jnp.arange(flat_ids[e].shape[1]) // W  # [P_e]
+        if e == 0:
+            acc = pick
+        else:
+            # parent row r at step e descends from the pick with
+            # rank==r at step e-1; map rank -> flat pos via argsort
+            prev_rank = jnp.where(valid[e - 1], ranks[e - 1],
+                                  jnp.iinfo(jnp.int32).max)
+            prev_rank = pad_to(prev_rank, jnp.iinfo(jnp.int32).max)
+            rank_to_pos = jnp.argsort(prev_rank, axis=-1)  # [B, P]
+            parent_pos = jnp.take_along_axis(
+                rank_to_pos, parent_row[None, :].repeat(B, 0), axis=-1)
+            parent_acc = jnp.take_along_axis(prev_acc, parent_pos,
+                                             axis=-1)
+            acc = pick + parent_acc
+        acc_p = pad_to(jnp.where(valid[e], acc, NEG), NEG)
+        path_scores.append(acc_p)
+        path_valids.append(pad_to(valid[e], False))
+        prev_acc = acc_p
+
+    # select the last-valid step's paths per sequence
+    ps = jnp.stack(path_scores, axis=1)    # [B, E, P]
+    pv = jnp.stack(path_valids, axis=1)
+    sel_ps = jnp.take_along_axis(
+        ps, lv[:, None, None], axis=1)[:, 0]          # [B, P]
+    sel_pv = jnp.take_along_axis(pv, lv[:, None, None], axis=1)[:, 0]
+
+    # gold path score: sum of gold pick scores up to lv
+    gold_steps = [
+        jnp.take_along_axis(scores[e], gold[e][:, None],
+                            axis=-1)[:, 0].astype(jnp.float32)
+        for e in range(E)]
+    gold_cum = jnp.cumsum(jnp.stack(gold_steps, axis=-1), axis=-1)
+    gold_score = jnp.take_along_axis(gold_cum, lv[:, None],
+                                     axis=-1)[:, 0]
+
+    # if gold survived to lv, its slot is its pick's position there;
+    # else append it as the extra path
+    gold_pos_lv = jnp.stack(gold_flat_pos, axis=-1)
+    gold_pos = jnp.take_along_axis(gold_pos_lv, lv[:, None],
+                                   axis=-1)[:, 0]
+    survived = ~any_fell
+    all_scores = jnp.concatenate(
+        [sel_ps, jnp.where(survived, NEG, gold_score)[:, None]], axis=-1)
+    all_valid = jnp.concatenate(
+        [sel_pv, (~survived)[:, None]], axis=-1)
+    logits = jnp.where(all_valid, all_scores, NEG)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold_logit = jnp.where(
+        survived,
+        jnp.take_along_axis(sel_ps, gold_pos[:, None], axis=-1)[:, 0],
+        gold_score)
+    return {"Out": (logz - gold_logit)[:, None]}

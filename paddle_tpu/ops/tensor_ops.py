@@ -53,6 +53,11 @@ def _transpose(ctx):
     return {"Out": jnp.transpose(ctx.input("X"), ctx.attr("axis"))}
 
 
+@register_op("flip")
+def _flip(ctx):
+    return {"Out": jnp.flip(ctx.input("X"), axis=ctx.attr("axis"))}
+
+
 @register_op("pad")
 def _pad(ctx):
     x = ctx.input("X")

@@ -105,10 +105,12 @@ class OpTestHarness:
                     err_msg="op %s output %s[%d]" % (self.op_type, slot, i))
         return got
 
-    def check_grad(self, inputs_to_check, output_names=None, delta=5e-3,
-                   max_relative_error=0.005):
-        """Central-difference vs analytic gradient (reference
-        get_numeric_gradient / check_grad)."""
+    def analytic_grad_of_sum(self, inputs_to_check, output_names=None):
+        """Analytic d(sum(outputs))/d(input) per requested input — for
+        ops whose backward is DEFINED rather than derived (e.g.
+        lambda_cost's LambdaRank pseudo-gradients, where a numeric
+        check is meaningless because the forward is piecewise
+        constant). Compare against a reference transcription instead."""
         self._build()
         all_out = [n for ns in self.out_names.values() for n in ns]
         if output_names is None:
@@ -124,8 +126,23 @@ class OpTestHarness:
         with ptpu.scope_guard(self.scope):
             if self.startup.global_block().ops:
                 self.exe.run(self.startup)
-            analytic = self.exe.run(self.main, feed=self.feed,
-                                    fetch_list=grad_names)
+            return self.exe.run(self.main, feed=self.feed,
+                                fetch_list=grad_names)
+
+    def check_grad(self, inputs_to_check, output_names=None, delta=5e-3,
+                   max_relative_error=0.005):
+        """Central-difference vs analytic gradient (reference
+        get_numeric_gradient / check_grad)."""
+        self._build()
+        all_out = [n for ns in self.out_names.values() for n in ns]
+        if output_names is None:
+            output_names = all_out
+        input_names = []
+        for slot_i in inputs_to_check:
+            slot, i = (slot_i, 0) if isinstance(slot_i, str) else slot_i
+            input_names.append("in_%s_%d" % (slot, i))
+        analytic = self.analytic_grad_of_sum(inputs_to_check,
+                                             output_names)
 
         for name, ag in zip(input_names, analytic):
             base = self.feed[name].astype(np.float64)

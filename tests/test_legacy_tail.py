@@ -1,0 +1,429 @@
+"""gserver layer tail (ops/legacy_tail_ops.py, layers/legacy.py):
+bilinear_interp, selective_fc, data_norm, mdlstm, lambda_cost,
+cross_entropy_over_beam + the composition layers (reference
+BilinearInterpLayer.cpp, SelectiveFullyConnectedLayer.cpp,
+DataNormLayer.cpp, MDLstmLayer.cpp, CostLayer.cpp LambdaCost,
+CrossEntropyOverBeam.cpp, and the trainer_config_helpers DSL
+composites)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+from paddle_tpu.layers import legacy
+
+from op_test import OpTestHarness
+
+
+def _run(build):
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            fetches, feed = build()
+        exe = ptpu.Executor()
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetches)
+
+
+class TestBilinearInterp:
+    def test_matches_reference_math(self):
+        """Corner-aligned: out(i,j) interpolates with ratio
+        (in-1)/(out-1) (BilinearInterpLayer.cpp)."""
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        h = OpTestHarness("bilinear_interp", {"X": x},
+                          attrs={"out_h": 7, "out_w": 7})
+        got = h.check_output({}, atol=1e-5)
+        out = got["out_Out_0"]
+        assert out.shape == (1, 1, 7, 7)
+        # corners must match exactly (align_corners semantics)
+        np.testing.assert_allclose(out[0, 0, 0, 0], 0.0)
+        np.testing.assert_allclose(out[0, 0, 6, 6], 15.0)
+        np.testing.assert_allclose(out[0, 0, 0, 6], 3.0)
+        # center = exact bilinear midpoint
+        np.testing.assert_allclose(out[0, 0, 3, 3], 7.5)
+
+    def test_grad(self):
+        x = np.random.RandomState(3).randn(2, 3, 5, 4).astype("float32")
+        h = OpTestHarness("bilinear_interp", {"X": x},
+                          attrs={"out_h": 8, "out_w": 9})
+        h.check_grad(["X"])
+
+
+class TestSelectiveFC:
+    def test_matches_dense_columns(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(4, 6).astype("float32")
+        w = rs.randn(6, 10).astype("float32")
+        b = rs.randn(10).astype("float32")
+        sel = np.array([[0, 3, -1], [9, 1, 2], [5, 5, 5], [-1, -1, 7]],
+                       dtype="int64")
+        h = OpTestHarness("selective_fc",
+                          {"X": x, "W": w, "Bias": b, "Sel": sel},
+                          output_slots={"Out": 1})
+        dense = x @ w + b
+        want = np.zeros((4, 3), "float32")
+        for i in range(4):
+            for k in range(3):
+                if sel[i, k] >= 0:
+                    want[i, k] = dense[i, sel[i, k]]
+        h.check_output({"Out": want}, atol=1e-4, rtol=1e-4)
+
+    def test_grad_only_selected_columns(self):
+        """dW must be nonzero ONLY in selected columns (the sparse
+        interOutGrad_ semantics)."""
+        rs = np.random.RandomState(1)
+        x = rs.randn(3, 4).astype("float32")
+        w = rs.randn(4, 8).astype("float32")
+        sel = np.array([[1, 2], [2, 5], [1, -1]], dtype="int64")
+        h = OpTestHarness("selective_fc", {"X": x, "W": w, "Sel": sel},
+                          output_slots={"Out": 1})
+        h.check_grad([("X", 0), ("W", 0)])
+        # analytic dW sparsity: untouched output columns get zero grad
+        dw = np.asarray(h.analytic_grad_of_sum([("W", 0)])[0])
+        for c in (0, 3, 4, 6, 7):
+            np.testing.assert_allclose(dw[:, c], 0.0)
+
+    def test_full_output_is_plain_fc(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(3, 4).astype("float32")
+        w = rs.randn(4, 5).astype("float32")
+        h = OpTestHarness("selective_fc", {"X": x, "W": w},
+                          output_slots={"Out": 1})
+        h.check_output({"Out": x @ w}, atol=1e-4, rtol=1e-4)
+
+
+class TestDataNorm:
+    def test_modes(self):
+        x = np.array([[1.0, 10.0], [3.0, 30.0]], dtype="float32")
+        mean = np.array([2.0, 20.0], dtype="float32")
+        std = np.array([1.0, 10.0], dtype="float32")
+        h = OpTestHarness("data_norm", {"X": x, "Mean": mean,
+                                        "Std": std},
+                          attrs={"mode": "z-score"})
+        h.check_output({"Out": (x - mean) / std})
+
+        mn = np.array([1.0, 10.0], dtype="float32")
+        mx = np.array([3.0, 30.0], dtype="float32")
+        h = OpTestHarness("data_norm", {"X": x, "Min": mn, "Max": mx},
+                          attrs={"mode": "min-max"})
+        h.check_output({"Out": (x - mn) / (mx - mn)})
+
+        h = OpTestHarness("data_norm", {"X": x, "Max": mx},
+                          attrs={"mode": "decimal-scaling"})
+        # j = ceil(log10(max|x|)): 3 -> 1 digit, 30 -> 2 digits
+        h.check_output({"Out": x / np.array([10.0, 100.0], "float32")})
+
+    def test_layer_creates_stat_vars(self):
+        def build():
+            x = layers.data("x", shape=[2])
+            out = legacy.data_norm(x, mode="z-score",
+                                   stats={"mean": [2.0, 20.0],
+                                          "std": [1.0, 10.0]})
+            return [out], {"x": np.array([[3.0, 40.0]], "float32")}
+        out, = _run(build)
+        np.testing.assert_allclose(np.asarray(out), [[1.0, 2.0]],
+                                   atol=1e-5)
+
+
+class TestMDLstm:
+    def test_shapes_and_grad(self):
+        rs = np.random.RandomState(0)
+        nb = 4
+        gx = rs.randn(2, 3, 3, 5 * nb).astype("float32") * 0.3
+        wh = rs.randn(nb, 5 * nb).astype("float32") * 0.3
+        peep = rs.randn(4 * nb).astype("float32") * 0.1
+        h = OpTestHarness("mdlstm", {"GatesX": gx, "WeightH": wh,
+                                     "Peephole": peep},
+                          attrs={"directions": (True, True)})
+        got = h.check_output({})
+        assert got["out_Out_0"].shape == (2, 3, 3, nb)
+        h.check_grad([("GatesX", 0), ("WeightH", 0)],
+                     max_relative_error=0.02)
+
+    def test_corner_cell_is_plain_lstm_step(self):
+        """Cell (0,0) has no predecessors: c = ig*tanh(cell_in),
+        h = sigm(og + c*peep_og) * tanh(c)."""
+        rs = np.random.RandomState(1)
+        nb = 3
+        gx = rs.randn(1, 2, 2, 5 * nb).astype("float32")
+        wh = np.zeros((nb, 5 * nb), "float32")
+        peep = rs.randn(4 * nb).astype("float32")
+        h = OpTestHarness("mdlstm", {"GatesX": gx, "WeightH": wh,
+                                     "Peephole": peep},
+                          attrs={"directions": (True, True)})
+        got = h.check_output({})
+        g = gx[0, 0, 0]
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+        ig = sig(g[:nb])
+        cell = np.tanh(g[4 * nb:])
+        c = ig * cell
+        og = sig(g[3 * nb:4 * nb] + c * peep[3 * nb:])
+        want = np.tanh(c) * og
+        np.testing.assert_allclose(got["out_Out_0"][0, 0, 0], want,
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_direction_flip_matches_flipped_input(self):
+        rs = np.random.RandomState(2)
+        nb = 2
+        gx = rs.randn(1, 3, 2, 5 * nb).astype("float32") * 0.4
+        wh = rs.randn(nb, 5 * nb).astype("float32") * 0.3
+        peep = np.zeros(4 * nb, "float32")
+        fwd = OpTestHarness("mdlstm", {"GatesX": gx[:, ::-1].copy(),
+                                       "WeightH": wh, "Peephole": peep},
+                            attrs={"directions": (True, True)})
+        rev = OpTestHarness("mdlstm", {"GatesX": gx, "WeightH": wh,
+                                       "Peephole": peep},
+                            attrs={"directions": (False, True)})
+        a = fwd.check_output({})["out_Out_0"][:, ::-1]
+        b = rev.check_output({})["out_Out_0"]
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def _ref_ndcg(out_scores, labels, k):
+    order = np.argsort(-out_scores)
+    dcg = sum((2.0 ** labels[order[i]] - 1) / np.log(i + 2)
+              for i in range(k))
+    ideal = np.sort(labels)[::-1]
+    mdcg = sum((2.0 ** ideal[i] - 1) / np.log(i + 2) for i in range(k))
+    return dcg / mdcg
+
+
+def _ref_lambda_grads(out_scores, labels, k):
+    """Direct transcription of CostLayer.cpp LambdaCost::calcGrad
+    (full sort)."""
+    n = len(out_scores)
+    order = list(np.argsort(-labels, kind="stable"))
+    mdcg = sum((2.0 ** labels[order[i]] - 1) / np.log(i + 2)
+               for i in range(k))
+    grad = np.zeros(n)
+    for i in range(n):
+        for j in range(i + 1, n):
+            ii, jj = order[i], order[j]
+            dif = (2.0 ** labels[ii] - 2.0 ** labels[jj]) * \
+                (1 / np.log(i + 2) - 1 / np.log(j + 2))
+            lam = -abs(dif) / (1 + np.exp(out_scores[ii] -
+                                          out_scores[jj]))
+            grad[ii] += lam / mdcg
+            grad[jj] -= lam / mdcg
+    return grad
+
+
+class TestLambdaCost:
+    def test_forward_is_ndcg(self):
+        rs = np.random.RandomState(0)
+        out = rs.randn(2, 6).astype("float32")
+        lab = rs.randint(0, 4, (2, 6)).astype("float32")
+        length = np.array([6, 4], dtype="int64")
+        h = OpTestHarness("lambda_cost",
+                          {"X": out, "Score": lab, "Length": length},
+                          attrs={"NDCG_num": 3})
+        got = h.check_output({})["out_Out_0"]
+        np.testing.assert_allclose(got[0, 0],
+                                   _ref_ndcg(out[0], lab[0], 3),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(got[1, 0],
+                                   _ref_ndcg(out[1, :4], lab[1, :4], 3),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(got[1, 4:], 0.0)  # padding
+
+    def test_backward_matches_reference_lambdas(self):
+        rs = np.random.RandomState(1)
+        out = rs.randn(1, 5).astype("float32")
+        lab = np.array([[2.0, 0.0, 3.0, 1.0, 0.0]], dtype="float32")
+        length = np.array([5], dtype="int64")
+        h = OpTestHarness("lambda_cost",
+                          {"X": out, "Score": lab, "Length": length},
+                          attrs={"NDCG_num": 2})
+        # analytic grad of sum(out) wrt X should equal the reference
+        # lambda grads (sum over L elements -> mean cotangent 1)
+        grads = h.analytic_grad_of_sum([("X", 0)])
+        np.testing.assert_allclose(
+            np.asarray(grads[0])[0], _ref_lambda_grads(out[0], lab[0], 2),
+            rtol=1e-4, atol=1e-6)
+
+
+class TestCrossEntropyOverBeam:
+    def test_single_step_is_softmax_ce(self):
+        """One expansion, gold on the beam: cost = -log softmax(scores
+        of beam picks)[gold]."""
+        scores = np.array([[0.1, 0.9, 0.3, 0.5]], dtype="float32")
+        ids = np.array([[[1, 3, 0]]], dtype="int64")      # picks
+        gold = np.array([3], dtype="int64")
+        h = OpTestHarness(
+            "cross_entropy_over_beam",
+            {"Scores": scores, "Ids": ids, "Gold": gold},
+            output_slots={"Out": 1})
+        picks = scores[0, [1, 3, 0]]
+        want = -(picks[1] - np.log(np.exp(picks).sum()))
+        h.check_output({"Out": np.array([[want]], "float32")},
+                       atol=1e-5, rtol=1e-5)
+
+    def test_gold_off_beam_joins_as_extra_path(self):
+        """Gold missing from step-0 picks -> gold as extra path
+        (goldAsExtraPath_); softmax over picks + gold."""
+        scores = np.array([[0.1, 0.9, 0.3, 0.5]], dtype="float32")
+        ids = np.array([[[1, 3, -1]]], dtype="int64")
+        gold = np.array([0], dtype="int64")
+        h = OpTestHarness(
+            "cross_entropy_over_beam",
+            {"Scores": scores, "Ids": ids, "Gold": gold},
+            output_slots={"Out": 1})
+        cand = np.array([0.9, 0.5, 0.1])  # picks 1,3 + gold 0
+        want = -(0.1 - np.log(np.exp(cand).sum()))
+        h.check_output({"Out": np.array([[want]], "float32")},
+                       atol=1e-5, rtol=1e-5)
+
+    def test_two_step_path_accumulation(self):
+        """Two expansions: path scores accumulate along parent chains;
+        gold survives both steps."""
+        s0 = np.array([[1.0, 2.0]], dtype="float32")
+        ids0 = np.array([[[0, 1]]], dtype="int64")        # both picked
+        g0 = np.array([1], dtype="int64")
+        # step 1: two rows (one per step-0 pick), 2 picks each
+        s1 = np.array([[0.5, 0.1, 0.7, 0.2]], dtype="float32")
+        ids1 = np.array([[[0, 1], [2, 3]]], dtype="int64")
+        g1 = np.array([2], dtype="int64")  # in row 1 (gold's rank=1)
+        h = OpTestHarness(
+            "cross_entropy_over_beam",
+            {"Scores": [s0, s1], "Ids": [ids0, ids1],
+             "Gold": [g0, g1]},
+            output_slots={"Out": 1})
+        # paths: (pick0: s0=1.0)+{0.5, 0.1}; (pick1: s0=2.0)+{0.7, 0.2}
+        paths = np.array([1.5, 1.1, 2.7, 2.2])
+        want = -(2.7 - np.log(np.exp(paths).sum()))
+        h.check_output({"Out": np.array([[want]], "float32")},
+                       atol=1e-4, rtol=1e-4)
+
+    def test_grad_flows(self):
+        scores = np.random.RandomState(0).randn(2, 5).astype("float32")
+        ids = np.array([[[0, 2, 4]], [[1, 3, -1]]], dtype="int64")
+        gold = np.array([2, 3], dtype="int64")
+        h = OpTestHarness(
+            "cross_entropy_over_beam",
+            {"Scores": scores, "Ids": ids, "Gold": gold},
+            output_slots={"Out": 1})
+        h.check_grad([("Scores", 0)], max_relative_error=0.01)
+
+
+class TestCompositionLayers:
+    def test_interpolation(self):
+        rs = np.random.RandomState(0)
+        a, b = rs.randn(3, 4).astype("float32"), \
+            rs.randn(3, 4).astype("float32")
+        w = rs.rand(3, 1).astype("float32")
+
+        def build():
+            x1 = layers.data("x1", shape=[4])
+            x2 = layers.data("x2", shape=[4])
+            wt = layers.data("w", shape=[1])
+            return [legacy.interpolation(x1, x2, wt)], \
+                {"x1": a, "x2": b, "w": w}
+        out, = _run(build)
+        np.testing.assert_allclose(np.asarray(out), w * a + (1 - w) * b,
+                                   rtol=1e-5)
+
+    def test_linear_comb(self):
+        rs = np.random.RandomState(1)
+        w = rs.randn(2, 3).astype("float32")
+        v = rs.randn(2, 12).astype("float32")
+
+        def build():
+            wt = layers.data("w", shape=[3])
+            vec = layers.data("v", shape=[12])
+            return [legacy.linear_comb(wt, vec, size=4)], \
+                {"w": w, "v": v}
+        out, = _run(build)
+        want = np.einsum("bm,bmn->bn", w, v.reshape(2, 3, 4))
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_slope_intercept_repeat_outprod(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(2, 3).astype("float32")
+        y = rs.randn(2, 2).astype("float32")
+
+        def build():
+            xv = layers.data("x", shape=[3])
+            yv = layers.data("y", shape=[2])
+            return [legacy.slope_intercept(xv, 2.0, 1.0),
+                    legacy.repeat(xv, 2),
+                    legacy.repeat(xv, 2, as_row_vector=False),
+                    legacy.out_prod(xv, yv)], {"x": x, "y": y}
+        si, rep_row, rep_el, op = _run(build)
+        np.testing.assert_allclose(np.asarray(si), 2 * x + 1, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(rep_row),
+                                   np.concatenate([x, x], axis=1))
+        np.testing.assert_allclose(np.asarray(rep_el),
+                                   np.repeat(x, 2, axis=1))
+        np.testing.assert_allclose(
+            np.asarray(op),
+            (x[:, :, None] * y[:, None, :]).reshape(2, -1), rtol=1e-5)
+
+    def test_rotate(self):
+        x = np.arange(12, dtype="float32").reshape(1, 1, 3, 4)
+
+        def build():
+            xv = layers.data("x", shape=[12])
+            return [legacy.rotate(xv, height=3, width=4)], \
+                {"x": x.reshape(1, 12)}
+        out, = _run(build)
+        want = np.rot90(x[0, 0], k=-1)  # clockwise
+        np.testing.assert_allclose(
+            np.asarray(out).reshape(4, 3), want)
+
+    def test_norm_and_distance(self):
+        rs = np.random.RandomState(3)
+        x = rs.rand(3, 5).astype("float32") + 0.1
+        y = rs.randn(3, 5).astype("float32")
+
+        def build():
+            xv = layers.data("x", shape=[5])
+            yv = layers.data("y", shape=[5])
+            return [legacy.sum_to_one_norm(xv),
+                    legacy.row_l2_norm(yv),
+                    legacy.l2_distance(xv, yv)], {"x": x, "y": y}
+        s1, l2n, dist = _run(build)
+        np.testing.assert_allclose(np.asarray(s1),
+                                   x / x.sum(1, keepdims=True),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(l2n), y / np.linalg.norm(y, axis=1,
+                                                keepdims=True),
+            rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(dist)[:, 0], np.linalg.norm(x - y, axis=1),
+            rtol=1e-4)
+
+    def test_gated_unit_and_costs(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(4, 6).astype("float32")
+        p = np.abs(rs.rand(4, 3).astype("float32")) + 0.05
+        p = p / p.sum(1, keepdims=True)
+        lab = np.array([[0], [2], [1], [0]], dtype="int64")
+        multi = (rs.rand(4, 3) > 0.5).astype("float32")
+
+        def build():
+            xv = layers.data("x", shape=[6])
+            pv = layers.data("p", shape=[3])
+            lv = layers.data("l", shape=[1], dtype="int64")
+            mv = layers.data("m", shape=[3])
+            return [legacy.gated_unit(xv, 5, act="tanh"),
+                    legacy.cross_entropy_with_selfnorm(pv, lv, 0.2),
+                    legacy.multi_binary_label_cross_entropy(pv, mv),
+                    legacy.sum_cost(xv)], \
+                {"x": x, "p": p, "l": lab, "m": multi}
+        gu, sn, mb, sc = _run(build)
+        assert np.asarray(gu).shape == (4, 5)
+        ce = -np.log(p[np.arange(4), lab[:, 0]])
+        z = p.sum(1)
+        np.testing.assert_allclose(
+            np.asarray(sn)[:, 0], ce + 0.2 * np.log(z) ** 2,
+            rtol=1e-4, atol=1e-5)
+        want_mb = -(multi * np.log(p + 1e-8) +
+                    (1 - multi) * np.log(1 - p + 1e-8)).sum(1)
+        np.testing.assert_allclose(np.asarray(mb)[:, 0], want_mb,
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(sc), x.sum(), rtol=1e-5)
