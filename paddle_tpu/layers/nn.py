@@ -24,7 +24,7 @@ __all__ = [
     "reduce_mean", "reduce_max", "reduce_min", "reduce_prod", "lrn",
     "maxout", "l2_normalize", "im2sequence", "one_hot", "clip",
     "clip_by_norm", "mean", "mul", "dot_product_attention", "cos_sim",
-    "hsigmoid", "nce", "row_conv", "prelu", "smooth_l1", "log_loss",
+    "hsigmoid", "nce", "row_conv", "conv_shift", "prelu", "smooth_l1", "log_loss",
     "huber_loss", "hinge_loss", "rank_loss", "margin_rank_loss",
     "bilinear_tensor_product", "spp", "elementwise_add", "elementwise_sub",
     "elementwise_mul", "elementwise_div", "elementwise_max",
@@ -597,6 +597,14 @@ def hinge_loss(input, label, name=None, **kwargs):
     return _single(helper, "hinge_loss",
                    {"Logits": [input.name], "Labels": [label.name]},
                    out_slot="Loss", dtype=input.dtype)
+
+
+def conv_shift(x, y, name=None, **kwargs):
+    """Circular 1-D correlation (reference conv_shift_op / v2
+    conv_shift_layer): out[b, i] = sum_j x[b, (i+j-M/2) mod N] * y[b, j]."""
+    helper = LayerHelper("conv_shift", name=name, **kwargs)
+    return _single(helper, "conv_shift",
+                   {"X": [x.name], "Y": [y.name]}, {})
 
 
 def rank_loss(left, right, label, name=None, **kwargs):

@@ -24,7 +24,7 @@ __all__ = ["sequence_mask", "sequence_pool", "sequence_first_step",
            "sequence_slice", "sequence_concat", "nested_sequence_mask",
            "nested_sequence_pool", "sub_seq", "sub_nested_seq",
            "nested_flatten", "nested_unflatten", "sequence_reshape",
-           "lod_reset", "max_sequence_len"]
+           "lod_reset", "max_sequence_len", "sequence_concat_packed"]
 
 
 def sequence_mask(length, maxlen, dtype="float32", **kwargs):
@@ -360,8 +360,11 @@ def sequence_reshape(input, new_dim, length=None, **kwargs):
         new_len = helper.create_tmp_variable(length.dtype,
                                              stop_gradient=True)
         outputs["OutLength"] = [new_len.name]
+    # infer_shape off: with a dynamic time axis the T*D divisibility
+    # check is only meaningful at trace time against the concrete feed
     helper.append_op(type="sequence_reshape", inputs=inputs,
-                     outputs=outputs, attrs={"new_dim": new_dim})
+                     outputs=outputs, attrs={"new_dim": new_dim},
+                     infer_shape=False)
     return out, new_len
 
 
@@ -392,3 +395,15 @@ def max_sequence_len(length, **kwargs):
                      inputs={"Length": [length.name]},
                      outputs={"Out": [out.name]})
     return out
+
+
+def sequence_concat_packed(a, b, len_a, len_b, **kwargs):
+    """Per-sample packed time concat: (out [B, Ta+Tb, ...], len [B])."""
+    helper = LayerHelper("sequence_concat_packed", **kwargs)
+    out = helper.create_tmp_variable(a.dtype)
+    out_len = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op(type="sequence_concat_packed",
+                     inputs={"A": [a.name], "B": [b.name],
+                             "LenA": [len_a.name], "LenB": [len_b.name]},
+                     outputs={"Out": [out.name], "OutLen": [out_len.name]})
+    return out, out_len

@@ -18,6 +18,8 @@ block_q rows per grid step.
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -25,25 +27,36 @@ from jax.experimental import pallas as pl
 _NEG = -1e30
 
 
-def _reference(q, k, v, causal):
-    """Plain jnp attention over [BH, T, D] (the backward path)."""
+def _reference(q, k, v, causal, seg=None):
+    """Plain jnp attention over [BH, T, D] (the backward path).
+    seg: [BH, T] int32 segment ids, 0 = padding — a key is attendable
+    by a query iff their ids match and the key's id is nonzero (covers
+    both padding masks and packed-sequence masks, SURVEY §5.7)."""
     s = jnp.einsum("bqd,bkd->bqk", q, k,
                    preferred_element_type=jnp.float32)
     s = s * (q.shape[-1] ** -0.5)
+    t = q.shape[1]
     if causal:
-        t = q.shape[1]
         mask = jnp.tril(jnp.ones((t, t), bool))
         s = jnp.where(mask[None], s, _NEG)
+    if seg is not None:
+        m = (seg[:, :, None] == seg[:, None, :]) & (seg[:, None, :] != 0)
+        s = jnp.where(m, s, _NEG)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    if seg is not None:
+        # fully-masked (padding) query rows: zero output, not uniform
+        p = p * (seg != 0)[:, :, None].astype(p.dtype)
     return jnp.einsum("bqk,bkd->bqd", p, v)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-            scale, causal, block_q, block_k, nk):
+def _body(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, acc_ref, m_ref,
+          l_ref, *, scale, causal, block_q, block_k, nk):
     """One (q-block, k-block) step of flash attention with online
     softmax. The k axis is the innermost (sequential) grid dim, so the
     VMEM scratch (acc, running max m, running sum l) carries across
-    k blocks of the same q block."""
+    k blocks of the same q block. sq_ref/sk_ref (optional, [1, bq] /
+    [1, bk] int32 segment ids, 0 = padding) add the padding /
+    packed-sequence mask: key attendable iff ids match and nonzero."""
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -58,27 +71,43 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(live)
     def _step():
+        # explicit Precision: the executor's ambient
+        # default_matmul_precision('BF16_BF16_F32') is a
+        # DotAlgorithmPreset that Mosaic's dot lowering rejects; inside
+        # the kernel the MXU path is already bf16-multiply/f32-acc
         s = jnp.dot(q_ref[0], k_ref[0].T,
-                    preferred_element_type=jnp.float32) * scale
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.DEFAULT) * scale
+        mask = None
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
             mask = rows >= cols
+        if sq_ref is not None:
+            # sq_ref/sk_ref carry the FULL [1, 1, T] id row (Mosaic
+            # needs block dims divisible by (8,128) or whole-array; a
+            # (1,bq) block is neither) — slice the window in-kernel
+            sq = sq_ref[0, :, pl.ds(qi * block_q, block_q)]  # [1, bq]
+            sk = sk_ref[0, :, pl.ds(ki * block_k, block_k)]  # [1, bk]
+            seg_mask = (sq.reshape(block_q, 1) == sk) & (sk != 0)
+            mask = seg_mask if mask is None else (mask & seg_mask)
+        if mask is not None:
             s = jnp.where(mask, s, _NEG)
         m_prev = m_ref[:]                          # [bq, 128]
         m_new = jnp.maximum(m_prev,
                             jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, :1])
-        if causal:
+        if mask is not None:
             p = jnp.where(mask, p, 0.0)  # kill fully-masked rows
         l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=-1,
                                               keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha[:, :1] + jnp.dot(
             p.astype(v_ref.dtype), v_ref[0],
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
         m_ref[:] = m_new
 
     @pl.when(ki == nk - 1)
@@ -87,38 +116,52 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
 
 
-def _block_size(t, cap):
-    """Largest divisor of t that is <= cap, >= 128 and sublane-aligned
-    (multiple of 16 covers f32 and bf16 tiles) — avoids silently
-    falling back to the dense path for tileable lengths like 768 or
-    1280, while genuinely ragged lengths (e.g. 100) return 0 so the
-    caller uses the XLA reference instead of an unaligned kernel."""
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, **kw):
+    _body(q_ref, k_ref, v_ref, None, None, o_ref, acc_ref, m_ref,
+          l_ref, **kw)
+
+
+def _kernel_seg(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, acc_ref,
+                m_ref, l_ref, **kw):
+    _body(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, acc_ref, m_ref,
+          l_ref, **kw)
+
+
+def _block_size(t, cap, align=16):
+    """Largest divisor of t that is <= cap, >= 128 and ``align``-ed
+    (16 covers f32/bf16 sublane tiles; the segmented kernel needs 128 —
+    its in-kernel pl.ds slices of the id row must be lane-aligned) —
+    avoids silently falling back to the dense path for tileable lengths
+    like 768 or 1280, while genuinely ragged lengths (e.g. 100) return
+    0 so the caller uses the XLA reference instead of an unaligned
+    kernel."""
     if t <= cap:
-        return t if t % 16 == 0 else 0
+        return t if t % align == 0 else 0
     for b in range(cap, 127, -1):
-        if t % b == 0 and b % 16 == 0:
+        if t % b == 0 and b % align == 0:
             return b
     return 0
 
 
-def _forward(q, k, v, causal, block_q, interpret):
+def _forward(q, k, v, seg, causal, block_q, interpret):
     bh, t, d = q.shape
-    bq = _block_size(t, block_q)
-    bk = _block_size(t, 512)
+    align = 128 if seg is not None else 16
+    bq = _block_size(t, block_q, align)
+    bk = _block_size(t, 512, align)
     if not bq or not bk:
-        return _reference(q, k, v, causal)  # ragged length: XLA path
+        return _reference(q, k, v, causal, seg)  # ragged: XLA path
     from jax.experimental.pallas import tpu as pltpu
     grid = (bh, t // bq, t // bk)
-    return pl.pallas_call(
-        functools.partial(_kernel, scale=d ** -0.5, causal=causal,
-                          block_q=bq, block_k=bk, nk=t // bk),
+    kw = dict(scale=d ** -0.5, causal=causal, block_q=bq, block_k=bk,
+              nk=t // bk)
+    qkv_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+    ]
+    common = dict(
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-        ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),     # acc
@@ -126,16 +169,28 @@ def _forward(q, k, v, causal, block_q, interpret):
             pltpu.VMEM((bq, 128), jnp.float32),   # running sum
         ],
         interpret=interpret,
-    )(q, k, v)
+    )
+    if seg is None:
+        return pl.pallas_call(
+            functools.partial(_kernel, **kw),
+            in_specs=qkv_specs, **common)(q, k, v)
+    seg3 = seg.reshape(bh, 1, t)  # (1,1,t) blocks satisfy Mosaic's
+    return pl.pallas_call(         # (8,128)-or-whole-dim tiling rule
+        functools.partial(_kernel_seg, **kw),
+        in_specs=qkv_specs + [
+            pl.BlockSpec((1, 1, t), lambda b, i, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda b, i, j: (b, 0, 0)),
+        ], **common)(q, k, v, seg3, seg3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, block_q, interpret):
-    return _forward(q, k, v, causal, block_q, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, seg, causal, block_q, interpret):
+    return _forward(q, k, v, seg, causal, block_q, interpret)
 
 
-def _flash_fwd(q, k, v, causal, block_q, interpret):
-    return _forward(q, k, v, causal, block_q, interpret), (q, k, v)
+def _flash_fwd(q, k, v, seg, causal, block_q, interpret):
+    return _forward(q, k, v, seg, causal, block_q, interpret), \
+        (q, k, v, seg)
 
 
 def _flash_bwd(causal, block_q, interpret, res, g):
@@ -143,17 +198,21 @@ def _flash_bwd(causal, block_q, interpret, res, g):
     q-chunk at a time, so peak memory is O(bq * T) per batch-head —
     never the full [T, T] score matrix (training at T=8192 stays
     in-memory where the dense backward OOMs)."""
-    q, k, v = res
+    q, k, v, seg = res
     bh, t, d = q.shape
     scale = d ** -0.5
     bq = _block_size(t, block_q)
+    seg_ct = (None if seg is None else
+              np.zeros(seg.shape, jax.dtypes.float0))
     if not bq:
         _, vjp = jax.vjp(
-            lambda q_, k_, v_: _reference(q_, k_, v_, causal), q, k, v)
-        return vjp(g)
+            lambda q_, k_, v_: _reference(q_, k_, v_, causal, seg),
+            q, k, v)
+        return vjp(g) + (seg_ct,)
     nb = t // bq
     qc = q.reshape(bh, nb, bq, d)
     gc = g.reshape(bh, nb, bq, d)
+    segc = None if seg is None else seg.reshape(bh, nb, bq)
     cols = jnp.arange(t)
 
     def chunk(carry, idx):
@@ -162,11 +221,20 @@ def _flash_bwd(causal, block_q, interpret, res, g):
         gb = gc[:, idx]
         s = jnp.einsum("bqd,bkd->bqk", qb, k,
                        preferred_element_type=jnp.float32) * scale
+        mask = None
         if causal:
             rows = idx * bq + jnp.arange(bq)
-            s = jnp.where(rows[None, :, None] >= cols[None, None, :],
-                          s, _NEG)
+            mask = rows[None, :, None] >= cols[None, None, :]
+        if segc is not None:
+            sb = segc[:, idx]              # [bh, bq]
+            sm = (sb[:, :, None] == seg[:, None, :]) & \
+                (seg[:, None, :] != 0)
+            mask = sm if mask is None else (mask & sm)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG)
         p = jax.nn.softmax(s, axis=-1)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)  # fully-masked rows -> 0
         dp = jnp.einsum("bqd,bkd->bqk", gb, v,
                         preferred_element_type=jnp.float32)
         ds = (dp - jnp.sum(dp * p, axis=-1, keepdims=True)) * p
@@ -179,24 +247,36 @@ def _flash_bwd(causal, block_q, interpret, res, g):
         chunk, (jnp.zeros(k.shape, jnp.float32),
                 jnp.zeros(v.shape, jnp.float32)), jnp.arange(nb))
     dq = jnp.moveaxis(dqs, 0, 1).reshape(bh, t, d)
-    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype), seg_ct
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal=False, block_q=256,
-                    interpret=None):
+def flash_attention(q, k, v, causal=False, segment_ids=None,
+                    block_q=256, interpret=None):
     """q, k, v: [B, H, T, D] (or [BH, T, D]) -> same-shape output.
-    Fused Pallas forward + recompute backward. ``interpret=None``
-    auto-selects interpreter mode off-TPU."""
+    Fused Pallas forward + recompute backward. ``segment_ids``:
+    [B, T] int32, 0 = padding — a key is attendable iff its id matches
+    the query's and is nonzero (one mask covering the padded-batch
+    convention AND packed sequences, SURVEY §5.7). Padded query rows
+    yield zeros. ``interpret=None`` auto-selects interpreter mode
+    off-TPU."""
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu",)
     squeeze = q.ndim == 3
     if squeeze:
         q, k, v = q[None], k[None], v[None]
+        if segment_ids is not None and segment_ids.ndim == 1:
+            segment_ids = segment_ids[None]
     b, h, t, d = q.shape
+    seg = None
+    if segment_ids is not None:
+        seg = jnp.broadcast_to(
+            segment_ids.astype(jnp.int32)[:, None, :],
+            (b, h, t)).reshape(b * h, t)
     out = _flash(q.reshape(b * h, t, d), k.reshape(b * h, t, d),
-                 v.reshape(b * h, t, d), causal, block_q, interpret)
+                 v.reshape(b * h, t, d), seg, causal, block_q,
+                 interpret)
     out = out.reshape(b, h, t, d)
     return out[0] if squeeze else out

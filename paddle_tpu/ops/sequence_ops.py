@@ -356,3 +356,26 @@ def _max_sequence_len(ctx):
     LoD rank table)."""
     length = ctx.input("Length").reshape(-1)
     return {"Out": jnp.max(length).reshape(1)}
+
+
+@register_op("sequence_concat_packed")
+def _sequence_concat_packed(ctx):
+    """Per-sample time concatenation of two PADDED sequences (reference
+    SequenceConcatLayer over real LoD): out[i] = a[i,:la[i]] ++
+    b[i,:lb[i]], left-packed and zero-padded to Ta+Tb."""
+    a, b = ctx.input("A"), ctx.input("B")
+    la = ctx.input("LenA").reshape(-1).astype(jnp.int32)
+    lb = ctx.input("LenB").reshape(-1).astype(jnp.int32)
+    ta, tb = a.shape[1], b.shape[1]
+    src = jnp.concatenate([a, b], axis=1)        # [B, Ta+Tb, ...]
+    t = jnp.arange(ta + tb)[None, :]             # [1, T]
+    in_a = t < la[:, None]
+    idx = jnp.where(in_a, t, ta + (t - la[:, None]))
+    idx = jnp.clip(idx, 0, ta + tb - 1)
+    expand = (slice(None),) * 2 + (None,) * (a.ndim - 2)
+    gathered = jnp.take_along_axis(
+        src, idx[expand].astype(jnp.int32), axis=1)
+    valid = t < (la + lb)[:, None]
+    out = jnp.where(valid[expand], gathered,
+                    jnp.zeros((), src.dtype))
+    return {"Out": out, "OutLen": la + lb}

@@ -72,7 +72,11 @@ def _crop(ctx):
     x = ctx.input("X")
     offsets = ctx.attr("offsets")
     shape = ctx.attr("shape")
-    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    # -1 in shape = keep that dim from the offset to the end
+    # (dynamic-batch crops, reference crop_op shape semantics)
+    slices = tuple(
+        slice(o, None if s == -1 else o + s)
+        for o, s in zip(offsets, shape))
     return {"Out": x[slices]}
 
 

@@ -59,9 +59,13 @@ class Trainer:
     def __init__(self, loss, optimizer=None, feeder=None, metrics=None,
                  main_program=None, startup_program=None, strategy=None,
                  checkpoint_dir=None, checkpoint_every_n_steps=None,
-                 scheduler=None, place=None):
+                 scheduler=None, place=None, async_metrics=False):
         """metrics: {name: Variable} fetched each batch alongside loss.
         feeder: DataFeeder (or None — reader yields feed dicts directly).
+        async_metrics: keep per-batch metric fetches as device arrays —
+        no host sync per step, so the train loop runs dispatch-ahead
+        (the throughput recipe, PROFILE.md sink #1); event handlers can
+        still np.asarray() a metric when they actually need the value.
         """
         self.loss = loss
         self.main_program = main_program or default_main_program()
@@ -73,6 +77,7 @@ class Trainer:
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every_n_steps
         self.scheduler = scheduler
+        self.async_metrics = async_metrics
         self.step_id = 0
         self._initialized = False
 
@@ -105,7 +110,8 @@ class Trainer:
         names, vars_ = self._fetches()
         with timer("trainOneBatch"):
             vals = self.exe.run(self.main_program, feed=feed,
-                                fetch_list=vars_)
+                                fetch_list=vars_,
+                                return_numpy=not self.async_metrics)
         self.step_id += 1
         if self.scheduler is not None:
             self.scheduler.step()
@@ -114,6 +120,8 @@ class Trainer:
             with timer("saveCheckpoint"):
                 _io.save_checkpoint(self.exe, self.checkpoint_dir,
                                     self.step_id, self.main_program)
+        if self.async_metrics:
+            return dict(zip(names, vals))
         return dict(zip(names, [np.asarray(v).item()
                                 if np.asarray(v).size == 1 else
                                 np.asarray(v) for v in vals]))

@@ -1,0 +1,656 @@
+"""The complete v2 layer DSL surface (paddle_tpu/v2/layer.py; reference
+``trainer_config_helpers/layers.py`` — SURVEY A.5): every public name
+is exercised with a real forward run; key families also train a step.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as ptpu
+import paddle_tpu.v2 as v2
+from paddle_tpu.v2 import layer as L
+from paddle_tpu.v2 import activation as act
+from paddle_tpu.v2 import pooling as pool
+from paddle_tpu.v2 import data_type as dt
+
+
+SURVEY_A5 = [
+    # projections / operators
+    "full_matrix_projection", "trans_full_matrix_projection",
+    "table_projection", "identity_projection", "slice_projection",
+    "scaling_projection", "dotmul_projection", "dotmul_operator",
+    "context_projection", "conv_projection", "conv_operator",
+    # layers
+    "mixed", "data", "embedding", "fc", "printer", "priorbox",
+    "multibox_loss", "detection_output", "roi_pool",
+    "cross_channel_norm", "pooling", "lstmemory", "grumemory",
+    "last_seq", "first_seq", "expand", "repeat", "seq_reshape",
+    "interpolation", "bilinear_interp", "power", "scaling", "trans",
+    "rotate", "cos_sim", "l2_distance", "hsigmoid", "img_conv",
+    "img_pool", "img_pool3d", "spp", "img_cmrnorm", "batch_norm",
+    "sum_to_one_norm", "row_l2_norm", "addto", "concat", "seq_concat",
+    "memory", "lstm_step", "gru_step", "gru_step_naive", "get_output",
+    "recurrent", "recurrent_group", "maxid", "dot_prod", "out_prod",
+    "eos", "beam_search", "square_error_cost", "classification_cost",
+    "pad", "conv_shift", "tensor", "selective_fc", "sampling_id",
+    "slope_intercept", "linear_comb", "block_expand", "maxout", "ctc",
+    "warp_ctc", "crf", "crf_decoding", "nce", "rank_cost",
+    "lambda_cost", "cross_entropy", "cross_entropy_with_selfnorm",
+    "cross_entropy_over_beam", "multi_binary_label_cross_entropy",
+    "sum_cost", "huber_regression_cost", "huber_classification_cost",
+    "smooth_l1_cost", "multiplex", "dropout", "row_conv", "prelu",
+    "gated_unit", "switch_order", "crop", "sub_nested_seq", "clip",
+    "seq_slice", "kmax_seq_score", "img_conv3d", "scale_shift",
+    "resize", "sub_seq", "scale_sub_region", "factorization_machine",
+]
+
+
+def test_every_a5_name_is_callable():
+    missing = [n for n in SURVEY_A5 if not callable(getattr(L, n, None))]
+    assert not missing, "A.5 names absent from v2.layer: %s" % missing
+    # the *_layer spellings too
+    missing_alias = [n for n in SURVEY_A5
+                     if n not in ("memory",)
+                     and not callable(getattr(L, n + "_layer", None))]
+    assert not missing_alias, missing_alias
+
+
+def _run(build, train_on=None, lr=0.1):
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            fetches, feed = build()
+            if train_on is not None:
+                ptpu.optimizer.SGD(learning_rate=lr).minimize(
+                    train_on(fetches), startup_program=startup)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        return [np.asarray(v) for v in
+                exe.run(main, feed=feed, fetch_list=fetches)]
+
+
+class TestDenseFamily:
+    def test_mixed_with_projections_trains(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(4, 6).astype("float32")
+        ids = rs.randint(0, 10, (4, 1)).astype("int64")
+
+        def build():
+            xv = L.data("x", dt.dense_vector(6))
+            iv = L.data("ids", dt.integer_value(10))
+            m = L.mixed(8, input=[
+                L.full_matrix_projection(xv),
+                L.table_projection(iv),
+                L.identity_projection(xv, offset=0, size=8)
+                if False else L.full_matrix_projection(xv),
+            ], act=act.Tanh())
+            lbl = L.data("lbl", dt.integer_value(3))
+            sm = L.fc(m, 3, act=act.Softmax())
+            cost = L.classification_cost(sm, lbl)
+            return [cost], {"x": x, "ids": ids,
+                            "lbl": rs.randint(0, 3, (4, 1)).astype(
+                                "int64")}
+        cost, = _run(build, train_on=lambda f: f[0])
+        assert np.isfinite(cost).all()
+
+    def test_identity_slice_scaling_dotmul_projections(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(3, 8).astype("float32")
+
+        def build():
+            xv = L.data("x", dt.dense_vector(8))
+            a = L.mixed(4, input=[L.identity_projection(
+                xv, offset=2, size=4)], bias_attr=False)
+            b = L.mixed(8, input=[L.slice_projection(
+                xv, [(0, 4), (4, 8)])], bias_attr=False)
+            c = L.mixed(8, input=[L.scaling_projection(xv)],
+                        bias_attr=False)
+            d = L.mixed(8, input=[L.dotmul_projection(xv)],
+                        bias_attr=False)
+            e = L.mixed(8, input=[L.dotmul_operator(xv, xv, scale=2.0)],
+                        bias_attr=False)
+            return [a, b, c, d, e], {"x": x}
+        a, b, c, d, e = _run(build)
+        np.testing.assert_allclose(a, x[:, 2:6], rtol=1e-6)
+        np.testing.assert_allclose(b, x, rtol=1e-6)
+        np.testing.assert_allclose(e, 2.0 * x * x, rtol=1e-5)
+
+    def test_elementwise_family(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(3, 5).astype("float32")
+        y = rs.randn(3, 5).astype("float32")
+        w = rs.rand(3, 1).astype("float32")
+
+        def build():
+            xv = L.data("x", dt.dense_vector(5))
+            yv = L.data("y", dt.dense_vector(5))
+            wv = L.data("w", dt.dense_vector(1))
+            return [L.addto([xv, yv]),
+                    L.interpolation([xv, yv], wv),
+                    L.scaling(xv, wv),
+                    L.slope_intercept(xv, 3.0, -1.0),
+                    L.dot_prod(xv, yv),
+                    L.cos_sim(xv, yv, scale=5),
+                    L.l2_distance(xv, yv),
+                    L.sum_to_one_norm(L.clip(xv, 0.1, 9.9)),
+                    L.row_l2_norm(yv),
+                    L.trans(xv)], {"x": x, "y": y, "w": w}
+        (ad, itp, sc, si, dp, cs, l2d, s1, rl2, tr) = _run(build)
+        np.testing.assert_allclose(ad, x + y, rtol=1e-5)
+        np.testing.assert_allclose(itp, w * x + (1 - w) * y, rtol=1e-5)
+        np.testing.assert_allclose(sc, w * x, rtol=1e-5)
+        np.testing.assert_allclose(si, 3 * x - 1, rtol=1e-5)
+        np.testing.assert_allclose(dp[:, 0], (x * y).sum(1), rtol=1e-4)
+        assert tr.shape == (5, 3)
+
+
+class TestImageFamily:
+    def test_conv_pool_norm_stack(self):
+        rs = np.random.RandomState(3)
+        img = rs.randn(2, 3 * 8 * 8).astype("float32")
+
+        def build():
+            iv = L.data("img", dt.dense_vector(3 * 8 * 8))
+            from paddle_tpu import layers as fl
+            x = fl.reshape(iv, [-1, 3, 8, 8])
+            c = L.img_conv(x, filter_size=3, num_filters=4, padding=1,
+                           act=act.Relu())
+            c = L.batch_norm(c, act=act.Relu())
+            c = L.img_cmrnorm(c, size=3)
+            p = L.img_pool(c, pool_size=2, stride=2,
+                           pool_type=pool.Max())
+            mo = L.maxout(L.img_conv(x, 3, 4, padding=1), groups=2)
+            sp = L.spp(c, pyramid_height=2)
+            pd = L.pad(x, pad_c=[0, 1], pad_h=[1, 1], pad_w=[0, 0])
+            cr = L.crop(pd, offset=[0, 0, 1, 0], shape=[-1, 3, 8, 8])
+            bi = L.bilinear_interp(x, out_size_x=12, out_size_y=10)
+            ro = L.rotate(iv, height=8, width=8 * 3)
+            sw = L.switch_order(x, reshape_order=[0, 2, 3, 1])
+            be = L.block_expand(x, block_x=4, block_y=4, stride_x=4,
+                                stride_y=4)
+            return [c, p, mo, sp, pd, cr, bi, ro, sw, be], {"img": img}
+        outs = _run(build)
+        c, p, mo, sp, pd, cr, bi, ro, sw, be = outs
+        assert c.shape == (2, 4, 8, 8)
+        assert p.shape == (2, 4, 4, 4)
+        assert mo.shape == (2, 2, 8, 8)
+        assert pd.shape == (2, 4, 10, 8)
+        assert cr.shape == (2, 3, 8, 8)
+        assert bi.shape == (2, 3, 10, 12)
+        assert sw.shape == (2, 8, 8, 3)
+
+    def test_conv3d_pool3d(self):
+        rs = np.random.RandomState(4)
+        vol = rs.randn(1, 2 * 4 * 4 * 4).astype("float32")
+
+        def build():
+            iv = L.data("vol", dt.dense_vector(2 * 4 * 4 * 4))
+            from paddle_tpu import layers as fl
+            x = fl.reshape(iv, [-1, 2, 4, 4, 4])
+            c = L.img_conv3d(x, filter_size=3, num_filters=3,
+                             padding=1, act=act.Relu())
+            p = L.img_pool3d(c, pool_size=2, stride=2)
+            return [c, p], {"vol": vol}
+        c, p = _run(build)
+        assert c.shape == (1, 3, 4, 4, 4)
+        assert p.shape == (1, 3, 2, 2, 2)
+
+    def test_detection_family(self):
+        rs = np.random.RandomState(5)
+
+        def build():
+            from paddle_tpu import layers as fl
+            feat = fl.data("feat", shape=[4, 2, 2],
+                           append_batch_size=True)
+            img = fl.data("img", shape=[3, 16, 16])
+            pb, pv = L.priorbox(feat, img, min_size=[4.0],
+                                max_size=[8.0], aspect_ratio=[2.0])
+            rois = fl.data("rois", shape=[5], append_batch_size=True)
+            x = fl.data("x", shape=[2, 8, 8])
+            rp = L.roi_pool(x, rois, pooled_width=2, pooled_height=2)
+            cc = L.cross_channel_norm(x)
+            return [pb, pv, rp, cc], {
+                "feat": rs.randn(1, 4, 2, 2).astype("float32"),
+                "img": rs.randn(1, 3, 16, 16).astype("float32"),
+                "rois": np.array([[0, 0, 0, 7, 7]], "float32"),
+                "x": rs.randn(1, 2, 8, 8).astype("float32")}
+        pb, pv, rp, cc = _run(build)
+        assert pb.shape[-1] == 4 and cc.shape == (1, 2, 8, 8)
+
+
+class TestSequenceFamily:
+    def _seq_feed(self, rs, B=3, T=6, V=20):
+        ids = rs.randint(1, V, (B, T)).astype("int64")
+        lens = np.array([T, T - 2, T - 3], dtype="int64")
+        return ids, lens
+
+    def test_recurrent_pipeline_trains(self):
+        rs = np.random.RandomState(6)
+        ids, lens = self._seq_feed(rs)
+
+        def build():
+            tok = L.data("tok", dt.integer_value_sequence(20))
+            lbl = L.data("lbl", dt.integer_value(2))
+            emb = L.embedding(tok, 8)
+            lg = L.lstmemory(L.fc(emb, 24), size=6)
+            gg = L.grumemory(L.fc(emb, 18), size=6)
+            pooled = L.pooling(lg, pooling_type=pool.Max())
+            lastg = L.last_seq(gg)
+            firstg = L.first_seq(gg)
+            feats = L.concat([pooled, lastg, firstg])
+            sm = L.fc(feats, 2, act=act.Softmax())
+            cost = L.classification_cost(sm, lbl)
+            return [cost, pooled, lastg], {
+                "tok": ids, "tok@len": lens,
+                "lbl": rs.randint(0, 2, (3, 1)).astype("int64")}
+        cost, pooled, lastg = _run(build, train_on=lambda f: f[0])
+        assert np.isfinite(cost).all()
+
+    def test_recurrent_group_with_memory(self):
+        rs = np.random.RandomState(7)
+        x = rs.randn(2, 5, 4).astype("float32") * 0.3
+
+        def build():
+            from paddle_tpu import layers as fl
+            xv = fl.data("x", shape=[5, 4])
+
+            def step(x_t):
+                prev = L.memory(size=3)
+                h = L.fc([x_t, prev], 3, act=act.Tanh())
+                L.update_memory(prev, h)
+                return h
+
+            out = L.recurrent_group(step, xv)
+            rec = L.recurrent(xv, act=act.Tanh())
+            return [out, rec], {"x": x}
+        out, rec = _run(build)
+        assert out.shape == (2, 5, 3)
+        assert rec.shape == (2, 5, 4)
+
+    def test_lstm_gru_steps_in_group(self):
+        rs = np.random.RandomState(8)
+        x = rs.randn(2, 4, 6).astype("float32") * 0.3
+
+        def build():
+            from paddle_tpu import layers as fl
+            xv = fl.data("x", shape=[4, 6])
+
+            def step(x_t):
+                cell = L.memory(size=5)
+                xproj = L.fc(x_t, 4 * 5, bias_attr=False)
+                h = L.lstm_step(xproj, cell, size=5)
+                return h
+
+            lstm_out = L.recurrent_group(step, xv)
+
+            def gstep(x_t):
+                hid = L.memory(size=5)
+                xproj = L.fc(x_t, 3 * 5, bias_attr=False)
+                return L.gru_step(xproj, hid, size=5)
+
+            gru_out = L.recurrent_group(gstep, xv)
+            return [lstm_out, gru_out], {"x": x}
+        lo, go = _run(build)
+        assert lo.shape == (2, 4, 5) and go.shape == (2, 4, 5)
+
+    def test_seq_shape_ops(self):
+        rs = np.random.RandomState(9)
+        ids, lens = self._seq_feed(rs)
+
+        def build():
+            tok = L.data("tok", dt.integer_value_sequence(20))
+            emb = L.embedding(tok, 6)
+            rs_ = L.seq_reshape(emb, reshape_size=12)
+            sl = L.seq_slice(emb, starts=1, ends=4)
+            exp_src = L.pooling(emb, pooling_type=pool.Avg())
+            ex = L.expand(exp_src, emb)
+            km = L.kmax_seq_score(L.fc(emb, 1), beam_size=2)
+            cc = L.seq_concat(emb, emb)
+            return [rs_, sl, ex, km, cc], {"tok": ids, "tok@len": lens}
+        rs_, sl, ex, km, cc = _run(build)
+        assert rs_.shape == (3, 3, 12)
+        assert sl.shape == (3, 3, 6)
+        assert ex.shape[1] == 6
+        assert cc.shape == (3, 12, 6)
+
+    def test_maxid_eos_sampling(self):
+        rs = np.random.RandomState(10)
+        p = np.abs(rs.rand(3, 7).astype("float32")) + 0.01
+
+        def build():
+            xv = L.data("p", dt.dense_vector(7))
+            return [L.maxid(xv), L.eos(xv, eos_id=3),
+                    L.sampling_id(xv)], {"p": p}
+        mid, e, sid = _run(build)
+        np.testing.assert_array_equal(mid[:, 0], p.argmax(1))
+        assert sid.shape[0] == 3
+
+    def test_beam_search_generates(self):
+        rs = np.random.RandomState(11)
+
+        def build():
+            from paddle_tpu import layers as fl
+            anchor = fl.data("anchor", shape=[1], dtype="int64")
+
+            def step(tok, ctx):
+                emb = fl.embedding(tok, size=[12, 8],
+                                   param_attr="gen_emb")
+                h = fl.fc(emb, 12, act="tanh")
+                return fl.fc(h, 12)
+
+            ids, lengths, scores = L.beam_search(
+                step, input=[L.StaticInput(anchor)], bos_id=0,
+                eos_id=1, beam_size=3, max_length=5)
+            return [ids, lengths], {
+                "anchor": np.zeros((2, 1), "int64")}
+        ids, lengths = _run(build)
+        assert ids.shape[0] == 2 and ids.shape[1] <= 5
+
+
+class TestCostFamily:
+    def test_all_costs_finite(self):
+        rs = np.random.RandomState(12)
+        B, C = 4, 5
+        logits = rs.randn(B, C).astype("float32")
+        probs = np.abs(rs.rand(B, C).astype("float32")) + 0.01
+        probs = probs / probs.sum(1, keepdims=True)
+        lbl = rs.randint(0, C, (B, 1)).astype("int64")
+        multi = (rs.rand(B, C) > 0.5).astype("float32")
+        reg = rs.randn(B, 3).astype("float32")
+        tgt = rs.randn(B, 3).astype("float32")
+        binlbl = np.sign(rs.randn(B, 1)).astype("float32")
+
+        def build():
+            lv = L.data("logits", dt.dense_vector(C))
+            pv = L.data("probs", dt.dense_vector(C))
+            yv = L.data("lbl", dt.integer_value(C))
+            mv = L.data("multi", dt.dense_vector(C))
+            rv = L.data("reg", dt.dense_vector(3))
+            tv = L.data("tgt", dt.dense_vector(3))
+            bv = L.data("bin", dt.dense_vector(1))
+            outs = [
+                L.classification_cost(lv, yv),
+                L.cross_entropy(pv, yv),
+                L.cross_entropy_with_selfnorm(pv, yv),
+                L.multi_binary_label_cross_entropy(pv, mv),
+                L.regression_cost(rv, tv),
+                L.square_error_cost(rv, tv),
+                L.sum_cost(rv),
+                L.huber_regression_cost(rv, tv),
+                L.huber_classification_cost(
+                    L.fc(rv, 1, bias_attr=False), bv),
+                L.smooth_l1_cost(rv, tv),
+                L.rank_cost(L.fc(rv, 1), L.fc(tv, 1), bv),
+            ]
+            return outs, {"logits": logits, "probs": probs,
+                          "lbl": lbl, "multi": multi, "reg": reg,
+                          "tgt": tgt, "bin": binlbl}
+        outs = _run(build)
+        for o in outs:
+            assert np.isfinite(o).all()
+
+    def test_structured_costs(self):
+        rs = np.random.RandomState(13)
+        B, T, C = 2, 5, 4
+        emissions = rs.randn(B, T, C).astype("float32")
+        tags = rs.randint(0, C, (B, T)).astype("int64")
+        lens = np.array([T, T - 1], dtype="int64")
+
+        def build():
+            from paddle_tpu import layers as fl
+            ev = fl.data("em", shape=[T, C])
+            tv = fl.data("tags", shape=[T], dtype="int64")
+            ev._v2_length = fl.data("len", shape=[], dtype="int64")
+            c = L.crf(ev, tv)
+            d = L.crf_decoding(ev, param_attr="crf_w")
+            labels = fl.data("ctc_l", shape=[3], dtype="int64")
+            ctc_logits = fl.fc(ev, C + 1, num_flatten_dims=2)
+            llen = fl.data("llen", shape=[], dtype="int64")
+            cc = L.ctc(ctc_logits, labels, label_length=llen)
+            return [c, d, cc], {
+                "em": emissions, "tags": tags, "len": lens,
+                "ctc_l": rs.randint(1, C, (B, 3)).astype("int64"),
+                "llen": np.array([3, 2], "int64")}
+        c, d, cc = _run(build)
+        assert np.isfinite(c).all() and np.isfinite(cc).all()
+
+    def test_sampled_and_hierarchical(self):
+        rs = np.random.RandomState(14)
+        x = rs.randn(4, 6).astype("float32")
+        y = rs.randint(0, 10, (4, 1)).astype("int64")
+
+        def build():
+            xv = L.data("x", dt.dense_vector(6))
+            yv = L.data("y", dt.integer_value(10))
+            h = L.hsigmoid(xv, yv, num_classes=10)
+            n = L.nce(xv, yv, num_classes=10, num_neg_samples=3)
+            lc = L.lambda_cost(L.fc(xv, 1), L.fc(xv, 1), NDCG_num=2)
+            return [h, n], {"x": x, "y": y}
+        h, n = _run(build)
+        assert np.isfinite(h).all() and np.isfinite(n).all()
+
+
+class TestMiscFamily:
+    def test_misc_layers(self):
+        rs = np.random.RandomState(15)
+        a = rs.randn(3, 6).astype("float32")
+        b = rs.randn(3, 5).astype("float32")
+        f = rs.randn(3, 3).astype("float32")
+
+        def build():
+            av = L.data("a", dt.dense_vector(6))
+            bv = L.data("b", dt.dense_vector(5))
+            fv = L.data("f", dt.dense_vector(3))
+            idx = L.data("idx", dt.integer_value(2))
+            t = L.tensor(av, bv, size=4)
+            sf = L.selective_fc(av, 10)
+            g = L.gated_unit(av, 7, act=act.Tanh())
+            cs = L.conv_shift(av, fv)
+            op = L.out_prod(av, bv)
+            lcmb = L.linear_comb(L.fc(av, 2), L.fc(av, 8), size=4)
+            mp = L.multiplex([idx, av, av])
+            fm = L.factorization_machine(av, factor_size=3)
+            dr = L.dropout(av, 0.0)
+            pr = L.prelu(av)
+            return [t, sf, g, cs, op, lcmb, mp, fm, dr, pr], {
+                "a": a, "b": b, "f": f,
+                "idx": np.zeros((3, 1), "int64")}
+        t, sf, g, cs, op, lcmb, mp, fm, dr, pr = _run(build)
+        assert t.shape == (3, 4) and sf.shape == (3, 10)
+        assert g.shape == (3, 7) and cs.shape == (3, 6)
+        assert op.shape == (3, 30) and lcmb.shape == (3, 4)
+        np.testing.assert_allclose(mp, a, rtol=1e-6)
+
+    def test_printer_runs(self):
+        def build():
+            xv = L.data("x", dt.dense_vector(2))
+            return [L.printer(xv)], {"x": np.ones((1, 2), "float32")}
+        out, = _run(build)
+        assert out.shape == (1, 2)
+
+
+class TestBookStyleScripts:
+    """Reference-shaped v2 book scripts (the trainer_config_helpers
+    idiom end-to-end: data -> layers -> cost -> SGD.train)."""
+
+    def test_sentiment_lstm_converges(self):
+        """understand_sentiment-style config: embedding -> fc ->
+        lstmemory -> max pooling -> softmax fc -> classification_cost
+        (reference demo/sentiment trainer_config)."""
+        rs = np.random.RandomState(0)
+        V, T, B, N = 30, 8, 8, 48
+        # separable synthetic task: class = which half of the vocab
+        # dominates the sequence
+        seqs = []
+        for i in range(N):
+            cls = i % 2
+            lo, hi = (1, V // 2) if cls == 0 else (V // 2, V)
+            toks = rs.randint(lo, hi, (T - (i % 3),))  # ragged
+            seqs.append((list(toks), cls))
+
+        def reader():
+            for i in range(0, N, B):
+                yield [(s[0], np.int64(s[1])) for s in seqs[i:i + B]]
+
+        import paddle_tpu.v2 as paddle
+        data = L.data("words", dt.integer_value_sequence(V))
+        lbl = L.data("label", dt.integer_value(2))
+        emb = L.embedding(data, 16)
+        fc1 = L.fc(emb, 32)
+        lstm = L.lstmemory(fc1, size=8)
+        pooled = L.pooling(lstm, pooling_type=pool.Max())
+        output = L.fc(pooled, 2, act=act.Softmax())
+        cost = L.classification_cost(output, lbl)
+        params = paddle.parameters.create(cost)
+        opt = paddle.optimizer.Adam(learning_rate=0.05)
+        trainer = paddle.trainer.SGD(cost, params, opt)
+        costs = []
+        trainer.train(
+            reader, num_passes=12,
+            feeding={"words": 0, "label": 1},
+            event_handler=lambda e: costs.append(e.cost)
+            if isinstance(e, paddle.event.EndIteration) else None)
+        assert costs[-1] < costs[0] * 0.5, (costs[0], costs[-1])
+
+    def test_ranking_lambda_cost_trains(self):
+        """mq2007-style LTR config: shared fc scorer over a document
+        list + lambda_cost (reference demo/quick_start ranking)."""
+        rs = np.random.RandomState(1)
+        B, Ld, D = 4, 6, 5
+        w_true = rs.randn(D).astype("float32")
+
+        with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+            main, startup = ptpu.Program(), ptpu.Program()
+            with ptpu.program_guard(main, startup):
+                from paddle_tpu import layers as fl
+                feats = fl.data("feats", shape=[Ld, D])
+                rel = fl.data("rel", shape=[Ld])
+                score = fl.fc(feats, 1, num_flatten_dims=2,
+                              bias_attr=False)
+                score = fl.reshape(score, [-1, Ld])
+                ndcg = L.lambda_cost(score, rel, NDCG_num=3)
+                ptpu.optimizer.Adam(learning_rate=0.05).minimize(
+                    ndcg, startup_program=startup)
+            exe = ptpu.Executor()
+            exe.run(startup)
+            vals = []
+            for step in range(40):
+                F = rs.randn(B, Ld, D).astype("float32")
+                relv = np.clip(np.round(F @ w_true), 0, 4).astype(
+                    "float32")
+                out, = exe.run(main, feed={"feats": F, "rel": relv},
+                               fetch_list=[ndcg])
+                vals.append(float(np.asarray(out)))
+            first = np.mean(vals[:5])
+            last = np.mean(vals[-5:])
+            assert last > first + 0.1, (first, last)
+
+
+class TestReviewRegressions:
+    """Paths the round-4 review flagged: keyword mismatches that were
+    silently swallowed by LayerHelper kwargs."""
+
+    def test_expand_respects_ragged_lengths(self):
+        rs = np.random.RandomState(20)
+        ids = rs.randint(1, 9, (2, 4)).astype("int64")
+        lens = np.array([4, 2], dtype="int64")
+
+        def build():
+            tok = L.data("tok", dt.integer_value_sequence(9))
+            emb = L.embedding(tok, 3)
+            pooled = L.pooling(emb, pooling_type=pool.Avg())
+            ex = L.expand(pooled, emb)
+            return [ex], {"tok": ids, "tok@len": lens}
+        ex, = _run(build)
+        # rows past sequence 1's length (2) must be zero
+        np.testing.assert_allclose(ex[1, 2:], 0.0)
+        assert np.abs(ex[1, 0]).sum() > 0
+
+    def test_switch_order_both_directions(self):
+        x = np.arange(24, dtype="float32").reshape(1, 2, 3, 4)
+
+        def build():
+            from paddle_tpu import layers as fl
+            xv = fl.data("x", shape=[2, 3, 4])
+            nhwc = L.switch_order(xv, reshape_order=[0, 2, 3, 1])
+            back = L.switch_order(nhwc, reshape_order=[0, 3, 1, 2])
+            return [nhwc, back], {"x": x}
+        nhwc, back = _run(build)
+        np.testing.assert_array_equal(nhwc, x.transpose(0, 2, 3, 1))
+        np.testing.assert_array_equal(back, x)
+        with pytest.raises(ValueError):
+            _run(lambda: ([L.switch_order(
+                __import__("paddle_tpu").layers.data("y", shape=[2, 3, 4]),
+                reshape_order=[3, 2, 1, 0])], {}))
+
+    def test_ssd_heads_through_v2(self):
+        rs = np.random.RandomState(21)
+
+        def build():
+            from paddle_tpu import layers as fl
+            feat = fl.data("feat", shape=[4, 2, 2])
+            img = fl.data("img", shape=[3, 16, 16])
+            pb = L.priorbox(feat, img, min_size=[4.0], max_size=[8.0],
+                            aspect_ratio=[2.0])
+            n_priors = 2 * 2 * 4
+            loc = fl.data("loc", shape=[n_priors, 4])
+            conf = fl.data("conf", shape=[n_priors, 3])
+            gt_box = fl.data("gt", shape=[2, 4])
+            gt_lbl = fl.data("gl", shape=[2], dtype="int64")
+            gt_cnt = fl.data("gc", shape=[], dtype="int64")
+            loss, _, _ = L.multibox_loss(loc, conf, pb, gt_box, gt_lbl,
+                                         gt_cnt, num_classes=3)
+            from paddle_tpu.layers import softmax
+            det = L.detection_output(loc, softmax(conf), pb,
+                                     num_classes=3, keep_top_k=4)
+            return [loss, det], {
+                "feat": rs.randn(1, 4, 2, 2).astype("float32"),
+                "img": rs.randn(1, 3, 16, 16).astype("float32"),
+                "loc": rs.randn(1, n_priors, 4).astype("float32") * .1,
+                "conf": rs.randn(1, n_priors, 3).astype("float32"),
+                "gt": np.array([[[.1, .1, .4, .4], [.5, .5, .9, .9]]],
+                               "float32"),
+                "gl": np.array([[1, 2]], "int64"),
+                "gc": np.array([2], "int64")}
+        loss, det = _run(build)
+        assert np.isfinite(loss).all() and det.shape[-1] == 6
+
+    def test_sub_nested_seq_two_arg_form(self):
+        rs = np.random.RandomState(22)
+        x = rs.randn(2, 3, 4, 5).astype("float32")  # [B, S, T, D]
+        sel = np.array([[2, 0], [1, -1]], dtype="int64")
+
+        def build():
+            from paddle_tpu import layers as fl
+            xv = fl.data("x", shape=[3, 4, 5])
+            sv = fl.data("sel", shape=[2], dtype="int64")
+            out = L.sub_nested_seq(xv, sv)
+            return [out if not isinstance(out, (list, tuple)) else
+                    out[0]], {"x": x, "sel": sel}
+        out, = _run(build)
+        np.testing.assert_allclose(out[0, 0], x[0, 2], rtol=1e-6)
+
+    def test_beam_search_memory_state(self):
+        """memory()/update_memory() inside a beam_search step (the
+        reference GRU-decoder generation idiom)."""
+        rs = np.random.RandomState(23)
+
+        def build():
+            from paddle_tpu import layers as fl
+            ctx = fl.data("ctx", shape=[6])
+
+            def step(tok, ctx_state):
+                h_prev = L.memory(size=6)
+                emb = fl.embedding(tok, size=[10, 6],
+                                   param_attr="bs_emb")
+                h = fl.fc([emb, h_prev, ctx_state], 6, act="tanh")
+                L.update_memory(h_prev, h)
+                return fl.fc(h, 10)
+
+            ids, lengths, scores = L.beam_search(
+                step, input=[L.StaticInput(ctx)], bos_id=0, eos_id=1,
+                beam_size=2, max_length=4)
+            return [ids, lengths], {
+                "ctx": rs.randn(2, 6).astype("float32")}
+        ids, lengths = _run(build)
+        assert ids.shape[0] == 2
