@@ -15,7 +15,10 @@ from .layer_helper import LayerHelper
 from .initializer import ConstantInitializer
 
 __all__ = ["Evaluator", "Accuracy", "ChunkEvaluator", "DetectionMAP",
-           "Auc", "PrecisionRecall", "PnPair", "EditDistanceEvaluator"]
+           "Auc", "PrecisionRecall", "PnPair", "EditDistanceEvaluator",
+           "SumEvaluator", "ColumnSumEvaluator", "ValuePrinter",
+           "GradientPrinter", "MaxIdPrinter", "MaxFramePrinter",
+           "SeqTextPrinter", "ClassificationErrorPrinter"]
 
 
 class Evaluator:
@@ -387,3 +390,160 @@ class EditDistanceEvaluator(Evaluator):
         total = float(np.asarray(scope.find_var(self._total.name)))
         n_seq = float(np.asarray(scope.find_var(self._count.name)))
         return total / max(n_seq, 1.0)
+
+
+class SumEvaluator(Evaluator):
+    """Accumulated sum of the input, reported per sample (reference
+    SumEvaluator, Evaluator.cpp:160-270; config api sum_evaluator).
+    Optional ``weight`` multiplies per-sample rows and divides the
+    sample count, as the reference's weighted mode."""
+
+    _NAME = "sum_evaluator"
+    _REDUCE_DIM = None   # full sum; ColumnSum keeps columns
+
+    def __init__(self, input, weight=None, **kwargs):
+        super().__init__(self._NAME, **kwargs)
+        shape = [] if self._REDUCE_DIM is None else [input.shape[-1]]
+        total = self._create_state("sum", shape, "float32")
+        samples = self._create_state("samples", [], "float32")
+        x = input
+        if weight is not None:
+            x = layers.elementwise_mul(input, weight)
+        bsum = layers.reduce_sum(x) if self._REDUCE_DIM is None \
+            else layers.reduce_sum(x, dim=self._REDUCE_DIM)
+        bn = layers.reduce_sum(weight) if weight is not None else \
+            layers.reduce_sum(
+                layers.fill_constant_batch_size_like(
+                    input, [-1], "float32", 1.0))
+        for state, batch in ((total, bsum), (samples, bn)):
+            self.helper.append_op(
+                type="sum", inputs={"X": [state.name, batch.name]},
+                outputs={"Out": [state.name]}, infer_shape=False)
+        self._sum, self._samples = total, samples
+
+    def eval(self, executor=None, scope=None):
+        scope = scope or global_scope()
+        s = np.asarray(scope.find_var(self._sum.name))
+        n = float(np.asarray(scope.find_var(self._samples.name)))
+        out = s / max(n, 1.0)
+        return float(out) if out.ndim == 0 else out
+
+
+class ColumnSumEvaluator(SumEvaluator):
+    """Per-column accumulated mean (reference ColumnSumEvaluator,
+    Evaluator.cpp:273-360; config api column_sum_evaluator).
+    ``col_idx``: report one column, or None for the full vector."""
+
+    _NAME = "column_sum_evaluator"
+    _REDUCE_DIM = 0
+
+    def __init__(self, input, weight=None, col_idx=None, **kwargs):
+        super().__init__(input, weight=weight, **kwargs)
+        self.col_idx = col_idx
+
+    def eval(self, executor=None, scope=None):
+        out = super().eval(executor, scope)
+        return float(out[self.col_idx]) if self.col_idx is not None \
+            else out
+
+
+# ---- printer evaluators ---------------------------------------------
+# The reference's debugging surface (Evaluator.cpp:1018-1357): each
+# prints its subject per batch. Temporaries never materialize in a
+# Scope here (SURVEY north star), so printers attach a print op INSIDE
+# the step — output appears each step via jax.debug.print (flush with
+# jax.effects_barrier()), rather than at eval() time.
+
+class _Printer(Evaluator):
+    def eval(self, executor=None, scope=None):
+        return None
+
+
+class ValuePrinter(_Printer):
+    """Print layer outputs (value_printer_evaluator)."""
+
+    def __init__(self, *inputs, **kwargs):
+        super().__init__("value_printer", **kwargs)
+        for v in inputs:
+            layers.Print(v, message="value_printer %s" % v.name)
+
+
+class GradientPrinter(_Printer):
+    """Print a variable's gradient (gradient_printer_evaluator).
+    Construct AFTER optimizer.minimize so the @GRAD vars exist."""
+
+    def __init__(self, *inputs, **kwargs):
+        super().__init__("gradient_printer", **kwargs)
+        block = self.helper.main_program.global_block()
+        for v in inputs:
+            # multi-consumer vars carry per-consumer contributions in
+            # name@GRAD, name@GRAD@1, ... with the TRUE sum in
+            # name@GRAD@SUM (core/backward.py) — print the sum if the
+            # var has one
+            gname = v.name + "@GRAD"
+            gsum = gname + "@SUM"
+            if block.has_var(gsum):
+                gname = gsum
+            elif not block.has_var(gname):
+                raise ValueError(
+                    "no gradient recorded for %r — construct "
+                    "GradientPrinter after minimize()" % v.name)
+            layers.Print(block.var(gname),
+                         message="gradient_printer %s" % gname)
+
+
+class MaxIdPrinter(_Printer):
+    """Print per-row argmax ids (maxid_printer_evaluator)."""
+
+    def __init__(self, input, **kwargs):
+        super().__init__("maxid_printer", **kwargs)
+        ids = layers.argmax(input, axis=-1)
+        layers.Print(ids, message="maxid_printer %s" % input.name)
+
+
+class MaxFramePrinter(_Printer):
+    """Print, per sequence, the frame (time step) with the max value
+    (maxframe_printer_evaluator)."""
+
+    def __init__(self, input, **kwargs):
+        super().__init__("maxframe_printer", **kwargs)
+        score = layers.reduce_max(input, dim=-1)
+        frame = layers.argmax(score, axis=-1)
+        layers.Print(frame, message="maxframe_printer %s" % input.name)
+
+
+class SeqTextPrinter(_Printer):
+    """Print generated id sequences (seq_text_printer_evaluator). The
+    reference maps ids through a dict file on the host; here ids print
+    in-step and ``to_text(ids, vocab)`` does the host-side join."""
+
+    def __init__(self, input, **kwargs):
+        super().__init__("seq_text_printer", **kwargs)
+        layers.Print(input, message="seq_text_printer %s" % input.name)
+
+    @staticmethod
+    def to_text(ids, vocab, eos_id=1):
+        out = []
+        for row in np.asarray(ids):
+            toks = []
+            for t in row:
+                if t == eos_id:
+                    break
+                toks.append(vocab[int(t)] if int(t) < len(vocab)
+                            else "<unk>")
+            out.append(" ".join(toks))
+        return out
+
+
+class ClassificationErrorPrinter(_Printer):
+    """Print per-sample 0/1 classification error
+    (classification_error_printer_evaluator)."""
+
+    def __init__(self, input, label, **kwargs):
+        super().__init__("classification_error_printer", **kwargs)
+        pred = layers.argmax(input, axis=-1)
+        lbl = layers.reshape(label, [-1])
+        err = layers.cast(
+            layers.control_flow.equal(pred, lbl), "float32")
+        err = layers.scale(err, scale=-1.0, bias=1.0)
+        layers.Print(err, message="classification_error_printer")

@@ -186,11 +186,25 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 def load_inference_model(dirname, executor, scope=None):
     """Returns (program, feed_names, fetch_names). The __model__ file is
     versioned JSON (data only — safe to load from untrusted model dirs,
-    unlike pickle; reference ships a protobuf ProgramDesc the same way)."""
-    with open(os.path.join(dirname, "__model__")) as f:
-        bundle = json.load(f)
-    from .core.serialization import program_from_dict
-    program = program_from_dict(bundle["program"])
-    load_params(executor, dirname, main_program=program, scope=scope)
+    unlike pickle; reference ships a protobuf ProgramDesc the same way).
+    ``dirname`` may also be a single merged-model FILE
+    (utils/merge_model.py), the capi/mobile deployment artifact."""
+    tmp_dir = None
+    if os.path.isfile(dirname):
+        from .utils.merge_model import unpack_merged_model
+        dirname = tmp_dir = unpack_merged_model(dirname)
+    try:
+        with open(os.path.join(dirname, "__model__")) as f:
+            bundle = json.load(f)
+        from .core.serialization import program_from_dict
+        program = program_from_dict(bundle["program"])
+        load_params(executor, dirname, main_program=program,
+                    scope=scope)
+    finally:
+        if tmp_dir is not None:
+            # params land in the scope during load; the unpacked dir
+            # is not needed afterwards (no leak per load)
+            import shutil
+            shutil.rmtree(tmp_dir, ignore_errors=True)
     spec = bundle["spec"]
     return program, spec["feed_names"], spec["fetch_names"]

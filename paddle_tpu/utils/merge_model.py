@@ -1,0 +1,47 @@
+"""Single-file inference model bundles (reference
+``python/paddle/utils/merge_model.py``: merge config + params into one
+file so the C API / mobile deployments ship a single artifact).
+
+A merged model is a plain zip of the inference dir's three members
+(``__model__`` JSON, ``params.npz``, ``params.meta.json``) — data-only,
+safe to load from untrusted sources (no pickle), and loadable by both
+``io.load_inference_model`` and the C API's ``ptc_model_load``.
+"""
+
+import os
+import tempfile
+import zipfile
+
+__all__ = ["merge_inference_model", "unpack_merged_model"]
+
+_MEMBERS = ("__model__", "params.npz", "params.meta.json")
+
+
+def merge_inference_model(dirname, out_file):
+    """Bundle a save_inference_model dir into ONE file."""
+    # validate BEFORE creating the zip: a failed merge must not leave
+    # a truncated artifact at out_file (or destroy a good one)
+    for m in _MEMBERS:
+        if not os.path.exists(os.path.join(dirname, m)):
+            raise FileNotFoundError(
+                "%r is not an inference model dir (missing %s)"
+                % (dirname, m))
+    with zipfile.ZipFile(out_file, "w", zipfile.ZIP_DEFLATED) as z:
+        for m in _MEMBERS:
+            z.write(os.path.join(dirname, m), m)
+    return out_file
+
+
+def unpack_merged_model(path):
+    """Extract a merged model file to a temp dir; returns the dir.
+    Zip-slip safe: member names are pinned to the known set."""
+    out = tempfile.mkdtemp(prefix="ptpu_model_")
+    with zipfile.ZipFile(path) as z:
+        names = set(z.namelist())
+        missing = [m for m in _MEMBERS if m not in names]
+        if missing:
+            raise ValueError("merged model %r missing members: %s"
+                             % (path, missing))
+        for m in _MEMBERS:
+            z.extract(m, out)
+    return out
