@@ -14,7 +14,9 @@ import os
 
 import numpy as np
 
-__all__ = ["data_home", "has_real", "Synthesizer"]
+__all__ = ["data_home", "has_real", "Synthesizer",
+           "md5file", "download", "word_tokenize",
+           "build_freq_dict"]
 
 
 def data_home(name):
@@ -27,6 +29,53 @@ def has_real(name, filename):
     return os.path.exists(os.path.join(data_home(name), filename))
 
 
+def md5file(fname):
+    import hashlib
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum):
+    """Download-with-cache (reference common.py:61): returns the cached
+    path when present AND md5-verified; otherwise fetches (3 retries).
+    This build environment has no egress — pre-seed the cache dir
+    ($PADDLE_TPU_DATASET_DIR/<module>/<basename>) and this is a pure
+    cache hit, exactly like a warmed reference ~/.cache."""
+    dirname = data_home(module_name)
+    os.makedirs(dirname, exist_ok=True)
+    filename = os.path.join(dirname, url.split("/")[-1])
+    retry = 0
+    last_err = None
+    while not (os.path.exists(filename) and
+               (md5sum is None or md5file(filename) == md5sum)):
+        if retry >= 3:
+            raise RuntimeError(
+                "cannot download %s within 3 retries (no network "
+                "egress? pre-seed %s)%s"
+                % (url, filename,
+                   ": last error %s" % last_err if last_err else ""))
+        retry += 1
+        # fetch to a temp name, rename only on success: a partial
+        # write must never be mistaken for a valid cache entry
+        # (especially with md5sum=None)
+        tmp = filename + ".part"
+        try:
+            import shutil
+            import urllib.request
+            with urllib.request.urlopen(url, timeout=60) as r, \
+                    open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            os.replace(tmp, filename)
+        except Exception as e:  # URLError, timeout, reset mid-copy
+            last_err = e
+            if os.path.exists(tmp):
+                os.remove(tmp)
+    return filename
+
+
 class Synthesizer:
     """Deterministic synthetic sample stream."""
 
@@ -34,3 +83,40 @@ class Synthesizer:
         seed = (hash((name, split)) & 0x7FFFFFFF) or 1
         self.rs = np.random.RandomState(seed)
         self.n = n
+
+
+_WORD_PAT = None
+
+
+def word_tokenize(text):
+    r"""Lowercase \W+ tokenization (the reference imdb/sentiment
+    tokenizer — shared so the corpora stay consistent)."""
+    global _WORD_PAT
+    if _WORD_PAT is None:
+        import re
+        _WORD_PAT = re.compile(r"\W+")
+    return [w for w in _WORD_PAT.split(text.lower()) if w]
+
+
+_dict_cache = {}
+
+
+def build_freq_dict(key, doc_iter_fn, cutoff=0, extra=()):
+    """Memoized frequency dict over a token-doc iterator; ids ordered
+    by (-frequency, word) ascending — the REFERENCE tie-break
+    (build_dict's key=lambda x: (-x[1], x[0])), so ids match dicts
+    built by the reference exactly. ``extra`` words append after."""
+    if key in _dict_cache:
+        return _dict_cache[key]
+    import collections
+    freq = collections.defaultdict(int)
+    for doc in doc_iter_fn():
+        for w in doc:
+            freq[w] += 1
+    kept = sorted(((w, f) for w, f in freq.items() if f > cutoff),
+                  key=lambda x: (-x[1], x[0]))
+    d = {w: i for i, (w, f) in enumerate(kept)}
+    for w in extra:
+        d[w] = len(d)
+    _dict_cache[key] = d
+    return d

@@ -35,15 +35,20 @@ def _real(path, start, end):
     return reader
 
 
+URL = ("https://archive.ics.uci.edu/ml/machine-learning-databases/"
+       "housing/housing.data")
+MD5 = "d4accdce7a25600298819f8e28e8d593"
+
+
 def train():
     p = os.path.join(common.data_home("uci_housing"), "housing.data")
-    if os.path.exists(p):
+    if common.has_real("uci_housing", "housing.data"):
         return _real(p, 0, 404)
     return _synth("train", 2048)
 
 
 def test():
     p = os.path.join(common.data_home("uci_housing"), "housing.data")
-    if os.path.exists(p):
+    if common.has_real("uci_housing", "housing.data"):
         return _real(p, 404, 506)
     return _synth("test", 256)
