@@ -43,6 +43,7 @@ __all__ = [
     "gated_unit", "power", "trans", "l2_distance", "sum_to_one_norm",
     "row_l2_norm", "eos", "cross_entropy_with_selfnorm",
     "multi_binary_label_cross_entropy", "sum_cost",
+    "cos_sim_vec_mat", "featmap_expand", "convex_comb",
 ]
 
 
@@ -310,3 +311,33 @@ def multi_binary_label_cross_entropy(input, label, name=None):
 def sum_cost(input, name=None):
     """Sum of the input as a scalar cost (reference SumCostLayer)."""
     return _ops.reduce_sum(input)
+
+
+def cos_sim_vec_mat(vec, mat, scale=1.0, name=None):
+    """cos_vm (reference CosSimVecMatLayer, 'used in NEURAL TURING
+    MACHINE'): out[b, i] = scale * cos(vec[b], mat[b, i*D:(i+1)*D]).
+    vec: [B, D]; mat: [B, M*D] -> [B, M]."""
+    d = vec.shape[-1]
+    m3 = _tensor.reshape(mat, [-1, mat.shape[-1] // d, d])
+    v3 = _tensor.reshape(vec, [-1, 1, d])
+    dots = _ops.reduce_sum(_ops.elementwise_mul(m3, v3), dim=-1)
+    vn = _ops.sqrt(_ops.reduce_sum(_ops.square(vec), dim=-1,
+                                   keep_dim=True))
+    mn = _ops.sqrt(_ops.reduce_sum(_ops.square(m3), dim=-1))
+    eps = 1e-8
+    cos = _ops.elementwise_div(
+        dots, _ops.scale(_ops.elementwise_mul(mn, vn), bias=eps))
+    return _ops.scale(cos, scale=float(scale)) if scale != 1.0 else cos
+
+
+def featmap_expand(input, num_filters, as_row_vector=True, name=None):
+    """FeatureMapExpandLayer: tile the feature row num_filters times —
+    y.row[i] = x.row[i mod width] (identical math to repeat with
+    as_row_vector=True; registered under the reference's name)."""
+    return repeat(input, num_filters, as_row_vector=as_row_vector)
+
+
+
+
+
+convex_comb = linear_comb  # reference REGISTER_LAYER(convex_comb, ...)

@@ -427,3 +427,35 @@ class TestCompositionLayers:
         np.testing.assert_allclose(np.asarray(mb)[:, 0], want_mb,
                                    rtol=1e-4, atol=1e-4)
         np.testing.assert_allclose(np.asarray(sc), x.sum(), rtol=1e-5)
+
+
+class TestA2Stragglers:
+    def test_cos_sim_vec_mat(self):
+        rs = np.random.RandomState(30)
+        v = rs.randn(2, 4).astype("float32")
+        m = rs.randn(2, 12).astype("float32")  # 3 rows of dim 4
+
+        def build():
+            vv = layers.data("v", shape=[4])
+            mv = layers.data("m", shape=[12])
+            return [legacy.cos_sim_vec_mat(vv, mv, scale=2.0)], \
+                {"v": v, "m": m}
+        out, = _run(build)
+        m3 = m.reshape(2, 3, 4)
+        want = 2.0 * (m3 * v[:, None]).sum(-1) / (
+            np.linalg.norm(m3, axis=-1) *
+            np.linalg.norm(v, axis=-1, keepdims=True) + 1e-8)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_featmap_expand_and_convex_comb(self):
+        rs = np.random.RandomState(31)
+        x = rs.randn(2, 3).astype("float32")
+
+        def build():
+            xv = layers.data("x", shape=[3])
+            return [legacy.featmap_expand(xv, 4)], {"x": x}
+        out, = _run(build)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.tile(x, (1, 4)), rtol=1e-6)
+        assert legacy.convex_comb is legacy.linear_comb
