@@ -324,7 +324,8 @@ def cos_sim_vec_mat(vec, mat, scale=1.0, name=None):
     vn = _ops.sqrt(_ops.reduce_sum(_ops.square(vec), dim=-1,
                                    keep_dim=True))
     mn = _ops.sqrt(_ops.reduce_sum(_ops.square(m3), dim=-1))
-    eps = 1e-8
+    eps = 1e-12  # the cos_sim op's epsilon (ops/math_ops.py) — one
+    # convention for every cosine path
     cos = _ops.elementwise_div(
         dots, _ops.scale(_ops.elementwise_mul(mn, vn), bias=eps))
     return _ops.scale(cos, scale=float(scale)) if scale != 1.0 else cos
@@ -335,9 +336,6 @@ def featmap_expand(input, num_filters, as_row_vector=True, name=None):
     y.row[i] = x.row[i mod width] (identical math to repeat with
     as_row_vector=True; registered under the reference's name)."""
     return repeat(input, num_filters, as_row_vector=as_row_vector)
-
-
-
 
 
 convex_comb = linear_comb  # reference REGISTER_LAYER(convex_comb, ...)
