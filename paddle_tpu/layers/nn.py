@@ -14,7 +14,8 @@ from ..param_attr import ParamAttr
 from ..initializer import ConstantInitializer, NormalInitializer
 
 __all__ = [
-    "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose",
+    "fc", "embedding", "conv2d", "batch_conv2d", "conv3d",
+    "conv2d_transpose",
     "conv3d_transpose", "factorization_machine", "pool2d",
     "switch_order", "scale_shift", "resize", "kmax_seq_score",
     "scale_sub_region",
@@ -134,6 +135,28 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                          outputs={"Out": [tmp.name]}, attrs={"axis": 1})
         out = tmp
     return helper.append_activation(out)
+
+
+def batch_conv2d(input, filter, stride=1, padding=0, dilation=1,
+                 name=None, **kwargs):
+    """Conv with a DATA-DEPENDENT filter: ``filter`` is another
+    variable's output, [B, O, C, kh, kw] — each batch row of ``input``
+    [B, C, H, W] is convolved with its own filter (reference
+    ConvOperator, gserver/layers/ConvOperator.cpp:59)."""
+    helper = LayerHelper("batch_conv2d", name=name, **kwargs)
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    padding = [padding, padding] if isinstance(padding, int) \
+        else list(padding)
+    dilation = [dilation, dilation] if isinstance(dilation, int) \
+        else list(dilation)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="batch_conv2d",
+                     inputs={"Input": [input.name],
+                             "Filter": [filter.name]},
+                     outputs={"Output": [out.name]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation})
+    return out
 
 
 def conv3d(input, num_filters, filter_size, stride=1, padding=0,

@@ -38,6 +38,29 @@ def _conv2d(ctx):
     return {"Output": out}
 
 
+@register_op("batch_conv2d")
+def _batch_conv2d(ctx):
+    """Per-sample-filter conv: each image row is convolved with its OWN
+    filter (reference ConvOperator, gserver/layers/ConvOperator.cpp:59-90
+    — the batched loop over hl_convolution_forward). Input [B, C, H, W],
+    Filter [B, O, C, kh, kw] -> Output [B, O, oh, ow]. jax.vmap's conv
+    batching rule lowers this to ONE grouped conv (feature_group_count=B)
+    so the MXU sees a single large contraction, not B small dispatches."""
+    x, w = ctx.input("Input"), ctx.input("Filter")
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+
+    def one(xi, wi):
+        return jax.lax.conv_general_dilated(
+            xi[None], wi, window_strides=strides,
+            padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+            rhs_dilation=dilations,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))[0]
+
+    return {"Output": jax.vmap(one)(x, w)}
+
+
 @register_op("conv3d")
 def _conv3d(ctx):
     x, w = ctx.input("Input"), ctx.input("Filter")

@@ -807,13 +807,43 @@ def conv_projection(input, filter_size, num_filters, num_channels=None,
 
 
 def conv_operator(img, filter, filter_size, num_filters,
-                  num_channels=None, stride=1, padding=0, **kwargs):
+                  num_channels=None, stride=1, padding=0,
+                  filter_size_y=None, stride_y=None, padding_y=None,
+                  **kwargs):
     """conv_operator: data-dependent filter conv inside mixed — the
-    filter comes from a layer, not a parameter."""
+    filter is another LAYER's output (one filter bank per batch row),
+    not a parameter (reference gserver/layers/ConvOperator.h:31,
+    ConvOperator.cpp:59 — per-row conv loop; config api
+    trainer_config_helpers conv_operator). The filter layer's width
+    must be num_filters*num_channels*kh*kw; output is the flattened
+    [B, num_filters*oh*ow] feature map, summable inside mixed()."""
+    kh = filter_size_y if filter_size_y is not None else filter_size
+    kw = filter_size
+    sy = stride_y if stride_y is not None else stride
+    py = padding_y if padding_y is not None else padding
+
     def fn(sz):
-        raise NotImplementedError(
-            "conv_operator with layer-valued filters maps to a "
-            "batched conv; use img_conv for parameter filters")
+        x = img
+        if len(x.shape) == 2:
+            if num_channels is None:
+                raise ValueError(
+                    "conv_operator on a flat input needs num_channels")
+            hw = x.shape[-1] // num_channels
+            side = int(round(float(hw) ** 0.5))
+            if side * side != hw:
+                raise ValueError(
+                    "conv_operator cannot infer a square image from "
+                    "width %d / %d channels" % (x.shape[-1],
+                                                num_channels))
+            x = _L.reshape(x, [-1, num_channels, side, side])
+        c = num_channels if num_channels is not None else x.shape[1]
+        f = filter
+        if len(f.shape) != 5:
+            f = _L.reshape(f, [-1, num_filters, c, kh, kw])
+        out = _L.batch_conv2d(x, f, stride=[sy, stride],
+                              padding=[py, padding])
+        return _L.reshape(out, [out.shape[0],
+                                int(np.prod(out.shape[1:]))])
     return _Projection(fn, img)
 
 

@@ -55,6 +55,29 @@ class TestConv:
                      output_names=["out_Output_0"],
                      max_relative_error=0.02)
 
+    def test_batch_conv2d_per_sample_filters(self):
+        """Each batch row convolved with its OWN filter (reference
+        ConvOperator.cpp:59 per-row loop)."""
+        x = RS.randn(3, 2, 6, 6).astype("float32")
+        w = RS.randn(3, 4, 2, 3, 3).astype("float32")
+        expect = np.stack([naive_conv2d(x[i:i + 1], w[i], 1, 1)[0]
+                           for i in range(3)])
+        t = OpTestHarness("batch_conv2d", {"Input": x, "Filter": w},
+                          attrs={"strides": [1, 1], "paddings": [1, 1]},
+                          output_slots={"Output": 1})
+        t.check_output({"Output": expect.astype("float32")}, rtol=1e-3,
+                       atol=1e-4)
+
+    def test_batch_conv2d_grad(self):
+        x = RS.randn(2, 2, 4, 4).astype("float32")
+        w = RS.randn(2, 2, 2, 3, 3).astype("float32")
+        t = OpTestHarness("batch_conv2d", {"Input": x, "Filter": w},
+                          attrs={"strides": [1, 1], "paddings": [1, 1]},
+                          output_slots={"Output": 1})
+        t.check_grad([("Input", 0), ("Filter", 0)],
+                     output_names=["out_Output_0"],
+                     max_relative_error=0.02)
+
     def test_conv2d_transpose_shape(self):
         x = RS.randn(1, 3, 4, 4).astype("float32")
         w = RS.randn(3, 5, 3, 3).astype("float32")  # [in, out, kh, kw]

@@ -72,17 +72,16 @@ def _run(build, train_on=None, lr=0.1):
 class TestDenseFamily:
     def test_mixed_with_projections_trains(self):
         rs = np.random.RandomState(0)
-        x = rs.randn(4, 6).astype("float32")
+        x = rs.randn(4, 8).astype("float32")
         ids = rs.randint(0, 10, (4, 1)).astype("int64")
 
         def build():
-            xv = L.data("x", dt.dense_vector(6))
+            xv = L.data("x", dt.dense_vector(8))
             iv = L.data("ids", dt.integer_value(10))
             m = L.mixed(8, input=[
                 L.full_matrix_projection(xv),
                 L.table_projection(iv),
-                L.identity_projection(xv, offset=0, size=8)
-                if False else L.full_matrix_projection(xv),
+                L.identity_projection(xv, offset=0, size=8),
             ], act=act.Tanh())
             lbl = L.data("lbl", dt.integer_value(3))
             sm = L.fc(m, 3, act=act.Softmax())
@@ -92,6 +91,91 @@ class TestDenseFamily:
                                 "int64")}
         cost, = _run(build, train_on=lambda f: f[0])
         assert np.isfinite(cost).all()
+
+    def test_conv_operator_layer_valued_filter(self):
+        """conv_operator applied inside mixed with a filter that is
+        another layer's output (reference ConvOperator: per-row conv of
+        image x filter) — numeric check against a per-sample numpy conv,
+        then a training step through the data-dependent filter path."""
+        rs = np.random.RandomState(31)
+        B, C, H, O, K = 2, 2, 5, 3, 3
+        img = rs.randn(B, C * H * H).astype("float32")
+        filt = (rs.randn(B, O * C * K * K) * 0.3).astype("float32")
+
+        def build():
+            iv = L.data("img", dt.dense_vector(C * H * H))
+            fv = L.data("filt", dt.dense_vector(O * C * K * K))
+            m = L.mixed(O * H * H, input=[
+                L.conv_operator(iv, fv, filter_size=K, num_filters=O,
+                                num_channels=C, padding=1)],
+                bias_attr=False)
+            return [m], {"img": img, "filt": filt}
+        out, = _run(build)
+        # per-sample numpy conv reference
+        x4 = img.reshape(B, C, H, H)
+        w5 = filt.reshape(B, O, C, K, K)
+        xp = np.pad(x4, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        exp = np.zeros((B, O, H, H), np.float64)
+        for b in range(B):
+            for i in range(H):
+                for j in range(H):
+                    patch = xp[b, :, i:i + K, j:j + K]
+                    for o in range(O):
+                        exp[b, o, i, j] = (patch * w5[b, o]).sum()
+        np.testing.assert_allclose(out, exp.reshape(B, -1), rtol=1e-3,
+                                   atol=1e-4)
+
+        # and the filter path is trainable: filter comes from an fc
+        def build_train():
+            iv = L.data("img", dt.dense_vector(C * H * H))
+            fgen = L.fc(iv, O * C * K * K, act=act.Tanh())
+            m = L.mixed(O * H * H, input=[
+                L.conv_operator(iv, fgen, filter_size=K, num_filters=O,
+                                num_channels=C, padding=1)],
+                bias_attr=False)
+            cost = L.sum_cost(m)
+            return [cost], {"img": img}
+        cost, = _run(build_train, train_on=lambda f: f[0])
+        assert np.isfinite(cost).all()
+
+    def test_conv_operator_asymmetric_kernel_stride(self):
+        """Pins the y-then-x mapping of filter_size_y/stride_y/padding_y
+        onto batch_conv2d (a kh/kw or sy/sx swap regression would pass
+        square-kernel tests undetected)."""
+        rs = np.random.RandomState(32)
+        B, C, H, W, O = 2, 1, 6, 7, 2
+        KH, KW, SY, SX, PY, PX = 2, 3, 2, 1, 1, 0
+        img = rs.randn(B, C * H * W).astype("float32")
+        filt = (rs.randn(B, O * C * KH * KW) * 0.5).astype("float32")
+        OH = (H + 2 * PY - KH) // SY + 1
+        OW = (W + 2 * PX - KW) // SX + 1
+
+        def build():
+            from paddle_tpu import layers as fl
+            iv = L.data("img", dt.dense_vector(C * H * W))
+            fv = L.data("filt", dt.dense_vector(O * C * KH * KW))
+            x4 = fl.reshape(iv, [-1, C, H, W])
+            m = L.mixed(O * OH * OW, input=[
+                L.conv_operator(x4, fv, filter_size=KW, num_filters=O,
+                                num_channels=C, stride=SX, padding=PX,
+                                filter_size_y=KH, stride_y=SY,
+                                padding_y=PY)],
+                bias_attr=False)
+            return [m], {"img": img, "filt": filt}
+        out, = _run(build)
+        x4 = img.reshape(B, C, H, W)
+        w5 = filt.reshape(B, O, C, KH, KW)
+        xp = np.pad(x4, ((0, 0), (0, 0), (PY, PY), (PX, PX)))
+        exp = np.zeros((B, O, OH, OW), np.float64)
+        for b in range(B):
+            for o in range(O):
+                for i in range(OH):
+                    for j in range(OW):
+                        patch = xp[b, :, i * SY:i * SY + KH,
+                                   j * SX:j * SX + KW]
+                        exp[b, o, i, j] = (patch * w5[b, o]).sum()
+        np.testing.assert_allclose(out, exp.reshape(B, -1), rtol=1e-3,
+                                   atol=1e-4)
 
     def test_identity_slice_scaling_dotmul_projections(self):
         rs = np.random.RandomState(1)
