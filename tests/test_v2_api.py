@@ -66,6 +66,77 @@ def test_v2_mlp_trains_tests_and_infers():
     assert np.abs(w).max() > 0
 
 
+def test_v2_parameters_tar_roundtrip():
+    """The v2 tar checkpoint idiom (reference parameters.py:328
+    to_tar / :358 from_tar / :387 init_from_tar and the book's
+    event-handler save): train -> save at EndPass -> perturb -> restore
+    -> identical inference."""
+    import io as _io
+
+    paddle.init()
+    images = paddle.layer.data("pixel",
+                               paddle.data_type.dense_vector(64))
+    label = paddle.layer.data("label",
+                              paddle.data_type.integer_value(10))
+    hidden = paddle.layer.fc(images, size=16,
+                             act=paddle.activation.Relu())
+    predict = paddle.layer.fc(hidden, size=10,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.1))
+
+    saves = []
+
+    def handler(event):
+        if isinstance(event, paddle.event.EndPass):
+            buf = _io.BytesIO()
+            trainer.save_parameter_to_tar(buf)
+            saves.append(buf.getvalue())
+
+    feeding = {"pixel": 0, "label": 1}
+    trainer.train(paddle.batch(_digits_reader(128), 32), num_passes=2,
+                  event_handler=handler, feeding=feeding)
+    assert len(saves) == 2
+
+    samples = list(_digits_reader(8, seed=5)())
+    probs_before = paddle.infer(output_layer=predict,
+                                parameters=parameters, input=samples,
+                                feeding=feeding)
+
+    # from_tar: a DETACHED handle carrying exactly the saved values
+    restored = paddle.parameters.Parameters.from_tar(
+        _io.BytesIO(saves[-1]))
+    assert sorted(restored.names()) == sorted(parameters.names())
+    for nm in parameters.names():
+        np.testing.assert_array_equal(restored.get(nm),
+                                      parameters.get(nm))
+        assert restored.get(nm).dtype == parameters.get(nm).dtype
+
+    # perturb the live scope, then init_from_tar restores it
+    for nm in parameters.names():
+        parameters.set(nm, parameters.get(nm) + 1.5)
+    probs_perturbed = paddle.infer(output_layer=predict,
+                                   parameters=parameters,
+                                   input=samples, feeding=feeding)
+    assert np.abs(probs_perturbed - probs_before).max() > 1e-3
+    parameters.init_from_tar(_io.BytesIO(saves[-1]))
+    probs_after = paddle.infer(output_layer=predict,
+                               parameters=parameters, input=samples,
+                               feeding=feeding)
+    np.testing.assert_allclose(probs_after, probs_before, rtol=1e-6)
+
+    # exclude_params leaves the excluded name perturbed
+    skip = parameters.names()[0]
+    parameters.set(skip, parameters.get(skip) + 2.0)
+    parameters.init_from_tar(_io.BytesIO(saves[-1]),
+                             exclude_params=[skip])
+    assert np.abs(parameters.get(skip) -
+                  restored.get(skip)).max() > 1.0
+
+
 def test_v2_sequence_classifier():
     paddle.init()
     words = paddle.layer.data(
