@@ -16,7 +16,8 @@ import numpy as np
 
 __all__ = ["data_home", "has_real", "Synthesizer",
            "md5file", "download", "word_tokenize",
-           "build_freq_dict"]
+           "build_freq_dict", "split", "cluster_files_reader",
+           "convert"]
 
 
 def data_home(name):
@@ -74,6 +75,89 @@ def download(url, module_name, md5sum):
             if os.path.exists(tmp):
                 os.remove(tmp)
     return filename
+
+
+def _shard_stream(reader, line_count, write_shard):
+    """Accumulate ``line_count`` samples per shard and hand each full
+    (or trailing partial) shard to ``write_shard(index, lines) ->
+    path``. Shared by split() and convert()."""
+    paths, lines, indx_f = [], [], 0
+
+    def flush():
+        nonlocal lines, indx_f
+        paths.append(write_shard(indx_f, lines))
+        lines = []
+        indx_f += 1
+
+    for d in reader():
+        lines.append(d)
+        if len(lines) >= line_count:
+            flush()
+    if lines:
+        flush()
+    return paths
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Split a sample stream into per-file pickled shards (reference
+    ``dataset/common.py:125`` split): ``suffix`` must contain one
+    ``%d``-style placeholder. Returns the list of written paths."""
+    import pickle
+    if dumper is None:
+        dumper = pickle.dump
+    if not callable(dumper):
+        raise TypeError("dumper should be callable.")
+
+    def write_shard(i, lines):
+        path = suffix % i
+        with open(path, "wb") as f:
+            dumper(lines, f)
+        return path
+
+    return _shard_stream(reader, line_count, write_shard)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Reader over the shard files assigned to this trainer by
+    round-robin rank (reference ``dataset/common.py:158``)."""
+    import glob
+    import pickle
+    if loader is None:
+        loader = pickle.load
+
+    def reader():
+        if not callable(loader):
+            raise TypeError("loader should be callable.")
+        file_list = sorted(glob.glob(files_pattern))
+        for idx, fn in enumerate(file_list):
+            if idx % trainer_count != trainer_id:
+                continue
+            with open(fn, "rb") as f:
+                for line in loader(f):
+                    yield line
+    return reader
+
+
+def convert(output_path, reader, line_count, name_prefix,
+            max_chunk_bytes=1 << 14):
+    """Convert a dataset reader to RecordIO shard files (reference
+    ``dataset/common.py:193``) — the bridge from the 13 dataset modules
+    to the elastic master's chunk tasks: feed the returned paths (or
+    the ``<output_path>/<name_prefix>-*`` glob) to
+    ``distributed.ElasticDataDispatcher``. ``line_count`` samples per
+    file; ``max_chunk_bytes`` sets the intra-file chunk (= task lease)
+    granularity. Returns the list of written paths."""
+    from ..reader.recordio import write_recordio
+    assert line_count >= 1
+    os.makedirs(output_path, exist_ok=True)
+
+    def write_shard(i, lines):
+        path = os.path.join(output_path, "%s-%05d" % (name_prefix, i))
+        write_recordio(path, lines, max_chunk_bytes=max_chunk_bytes)
+        return path
+
+    return _shard_stream(reader, line_count, write_shard)
 
 
 class Synthesizer:

@@ -119,16 +119,31 @@ class ElasticDataDispatcher:
     (reference cloud_reader + master GetTask loop)."""
 
     def __init__(self, client, recordio_path, worker_id="w0"):
+        """``recordio_path``: one path, a glob pattern, or a list of
+        paths (the output of ``dataset.common.convert`` — reference
+        cloud_reader's etcd glob, go/master/service.go partition)."""
         self.client = client
-        self.path = recordio_path
+        if isinstance(recordio_path, (list, tuple)):
+            self.paths = list(recordio_path)
+        elif any(ch in recordio_path for ch in "*?["):
+            import glob
+            self.paths = sorted(glob.glob(recordio_path))
+        else:
+            self.paths = [recordio_path]
+        if not self.paths:
+            raise ValueError("no recordio files match %r" % recordio_path)
         self.worker_id = worker_id
 
     def register_dataset(self):
         from ..reader import recordio as rio
-        n = rio.num_chunks(self.path)
-        for i in range(n):
-            self.client.add_task("chunk-%d" % i, str(i))
-        return n
+        total = 0
+        for pi, path in enumerate(self.paths):
+            n = rio.num_chunks(path)
+            for i in range(n):
+                self.client.add_task("chunk-%d-%d" % (pi, i),
+                                     "%d:%d" % (pi, i))
+            total += n
+        return total
 
     def reader(self, poll_interval=0.2, deserialize=None):
         """Yield samples from leased chunks until the pass completes.
@@ -148,10 +163,13 @@ class ElasticDataDispatcher:
                     time.sleep(poll_interval)
                     continue
                 task_id, epoch, payload = task
-                chunk = int(payload)
+                if ":" in payload:
+                    pi, chunk = (int(v) for v in payload.split(":"))
+                else:  # single-file payloads from older snapshots
+                    pi, chunk = 0, int(payload)
                 try:
                     for sample in rio.chunked_reader(
-                            self.path, [chunk], deserialize=de)():
+                            self.paths[pi], [chunk], deserialize=de)():
                         yield sample
                 except Exception:
                     self.client.task_failed(task_id, epoch)

@@ -1,0 +1,107 @@
+"""Worker for the end-to-end elastic resume test
+(test_native.py::test_elastic_training_resumes_after_worker_crash;
+the reference joint story: go/master chunk re-leasing
+``go/master/service.go:313-341`` + pserver checkpoint recovery
+``go/pserver/service.go:120-205``).
+
+Trains a linear regressor for ONE pass over an ElasticDataDispatcher
+reader (master-leased RecordIO chunks), checkpointing every step. With
+``crash_after_batches`` set, SIGKILLs itself mid-pass — the restarted
+worker must resume from the checkpoint and re-lease the dead lease's
+chunks from the (still-running) master.
+
+argv: repo master_port ds_glob ckpt_dir out_json crash_after_batches
+"""
+
+import json
+import os
+import signal
+import sys
+
+repo = sys.argv[1]
+master_port = int(sys.argv[2])
+ds_glob = sys.argv[3]
+ckpt_dir = sys.argv[4]
+out_json = sys.argv[5]
+crash_after = int(sys.argv[6])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=2")
+sys.path.insert(0, repo)
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as ptpu  # noqa: E402
+from paddle_tpu import layers  # noqa: E402
+from paddle_tpu.data_feeder import DataFeeder  # noqa: E402
+from paddle_tpu.distributed import (MasterClient,  # noqa: E402
+                                    ElasticDataDispatcher)
+from paddle_tpu.trainer import Trainer, EndIteration  # noqa: E402
+
+B = 8
+
+main, startup = ptpu.Program(), ptpu.Program()
+with ptpu.program_guard(main, startup):
+    xv = layers.data("x", shape=[4])
+    yv = layers.data("y", shape=[1])
+    pred = layers.fc(xv, 1, bias_attr=False, param_attr="w_lin")
+    loss = layers.mean(layers.square_error_cost(pred, yv))
+    ptpu.optimizer.SGD(learning_rate=0.05).minimize(
+        loss, startup_program=startup)
+
+trainer = Trainer(loss, feeder=DataFeeder([xv, yv]),
+                  main_program=main, startup_program=startup,
+                  checkpoint_dir=ckpt_dir, checkpoint_every_n_steps=1)
+trainer.startup()
+resumed_step = trainer.step_id
+
+client = MasterClient(master_port)
+disp = ElasticDataDispatcher(client, ds_glob,
+                             worker_id="w-%d" % os.getpid())
+seen = []
+
+
+def reader():
+    batch = []
+    for s in disp.reader()():
+        seen.append(int(s[0]))
+        batch.append((np.asarray(s[1], "float32"),
+                      np.asarray(s[2], "float32")))
+        if len(batch) == B:
+            yield batch
+            batch = []
+    if batch:
+        yield batch
+
+
+losses = []
+
+
+def handler(e):
+    if isinstance(e, EndIteration):
+        losses.append(float(e.cost))
+        if crash_after and len(losses) >= crash_after:
+            # flush progress for the harness, then die hard mid-pass
+            with open(out_json + ".crash", "w") as f:
+                json.dump({"losses": losses, "seen": seen,
+                           "step": trainer.step_id}, f)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+
+# synchronous consumption: staging/prefetch off so a crash at batch K
+# means exactly K*B leased samples were consumed
+trainer.train(reader, num_passes=1, event_handler=handler,
+              prefetch=0, staging=False)
+
+with open(out_json, "w") as f:
+    json.dump({"losses": losses, "seen": seen,
+               "resumed_step": resumed_step,
+               "final_step": trainer.step_id,
+               "w": np.asarray(
+                   ptpu.global_scope().find_var("w_lin")).tolist()}, f)
