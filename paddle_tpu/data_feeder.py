@@ -35,15 +35,117 @@ def bucket_batch_by_length(maxlen, buckets):
     return buckets[-1]
 
 
+def _norm_sparse_row(row):
+    """A sparse row is ``[(id, value), ...]``, ``([ids], [values])``,
+    or a bare id list (binary; all-ones values synthesized) —
+    reference SparseFloat/SparseBinaryScanner formats
+    (py_paddle/dataprovider_converter.py:154,184). The (ids, values)
+    form must be a tuple of two LISTS/arrays — a tuple of two (id,
+    value) TUPLES is parsed as a pair list, keeping the two forms
+    unambiguous."""
+    if isinstance(row, tuple) and len(row) == 2 and \
+            isinstance(row[0], (list, np.ndarray)):
+        ids, vals = row
+        return list(ids), [float(v) for v in vals]
+    row = list(row)
+    if row and isinstance(row[0], (tuple, list)):
+        return [p[0] for p in row], [float(p[1]) for p in row]
+    return row, [1.0] * len(row)
+
+
+def _pad_sparse(col, depth):
+    """Ragged sparse field -> (ids, values[, lengths[, sub_lengths]])
+    dense arrays. depth = number of sequence levels above the K axis
+    (0: [B,K]; 1: [B,T,K] + len; 2: [B,S,T,K] + len + sublen)."""
+    def rows_of(sample, d):
+        # normalize to a nested list-of-...-of (ids, vals) rows
+        return _norm_sparse_row(sample) if d == 0 else \
+            [rows_of(s, d - 1) for s in sample]
+
+    norm = [rows_of(s, depth) for s in col]
+    b = len(norm)
+    if depth == 0:
+        k = max(max((len(r[0]) for r in norm), default=1), 1)
+        ids = np.zeros((b, k), "int64")
+        vals = np.zeros((b, k), "float32")
+        for i, (rid, rv) in enumerate(norm):
+            ids[i, :len(rid)] = rid
+            vals[i, :len(rv)] = rv
+        return ids, vals
+    if depth == 1:
+        t = max(max((len(s) for s in norm), default=1), 1)
+        k = max(max((len(r[0]) for s in norm for r in s), default=1), 1)
+        ids = np.zeros((b, t, k), "int64")
+        vals = np.zeros((b, t, k), "float32")
+        lens = np.zeros((b,), "int64")
+        for i, s in enumerate(norm):
+            lens[i] = len(s)
+            for j, (rid, rv) in enumerate(s):
+                ids[i, j, :len(rid)] = rid
+                vals[i, j, :len(rv)] = rv
+        return ids, vals, lens
+    # depth == 2
+    s_max = max(max((len(s) for s in norm), default=1), 1)
+    t = max(max((len(sub) for s in norm for sub in s), default=1), 1)
+    k = max(max((len(r[0]) for s in norm for sub in s for r in sub),
+                default=1), 1)
+    ids = np.zeros((b, s_max, t, k), "int64")
+    vals = np.zeros((b, s_max, t, k), "float32")
+    lens = np.zeros((b,), "int64")
+    subl = np.zeros((b, s_max), "int64")
+    for i, s in enumerate(norm):
+        lens[i] = len(s)
+        for j, sub in enumerate(s):
+            subl[i, j] = len(sub)
+            for m, (rid, rv) in enumerate(sub):
+                ids[i, j, m, :len(rid)] = rid
+                vals[i, j, m, :len(rv)] = rv
+    return ids, vals, lens, subl
+
+
+def _pad_nested(col, dtype):
+    """Sub-sequence field (list of sub-seqs of scalars/vectors) ->
+    (data [B,S,T(,D)], lengths [B], sub_lengths [B,S]) — the
+    ops/nested_ops.py convention."""
+    b = len(col)
+    s_max = max(max((len(s) for s in col), default=1), 1)
+    t = max(max((len(sub) for s in col for sub in s), default=1), 1)
+    first = None
+    for s in col:
+        for sub in s:
+            if len(sub):
+                first = np.asarray(sub[0])
+                break
+        if first is not None:
+            break
+    tail = first.shape if first is not None and first.ndim else ()
+    data = np.zeros((b, s_max, t) + tail, dtype or "float32")
+    lens = np.zeros((b,), "int64")
+    subl = np.zeros((b, s_max), "int64")
+    for i, s in enumerate(col):
+        lens[i] = len(s)
+        for j, sub in enumerate(s):
+            subl[i, j] = len(sub)
+            if len(sub):
+                data[i, j, :len(sub)] = np.asarray(sub, data.dtype)
+    return data, lens, subl
+
+
 class DataFeeder:
     def __init__(self, feed_list, place=None, program=None,
                  seq_buckets=None):
-        """feed_list: Variables (or names). A Variable with a companion
-        length var is declared as a tuple (data_var, length_var) and fed
-        from variable-length samples."""
+        """feed_list entries:
+        * a Variable or name — dense field;
+        * (data_var, length_var) tuple — padded sequence field;
+        * a dict spec — structured field:
+          {"kind": "sparse", "name", "values", "depth",
+           "len"?, "sublen"?} or
+          {"kind": "nested", "name", "len", "sublen", "dtype"?}."""
         self.feed_specs = []
         for item in feed_list:
-            if isinstance(item, tuple):
+            if isinstance(item, dict):
+                self.feed_specs.append((item["kind"], item, None))
+            elif isinstance(item, tuple):
                 self.feed_specs.append(("seq", item[0], item[1]))
             else:
                 self.feed_specs.append(("dense", item, None))
@@ -58,6 +160,22 @@ class DataFeeder:
                              % (len(columns), n_fields))
         out = {}
         for (kind, var, len_var), col in zip(self.feed_specs, columns):
+            if kind == "sparse":
+                spec = var
+                arrs = _pad_sparse(col, spec["depth"])
+                out[spec["name"]], out[spec["values"]] = arrs[0], arrs[1]
+                if spec.get("len"):
+                    out[spec["len"]] = arrs[2]
+                if spec.get("sublen"):
+                    out[spec["sublen"]] = arrs[3]
+                continue
+            if kind == "nested":
+                spec = var
+                data, lens, subl = _pad_nested(col, spec.get("dtype"))
+                out[spec["name"]] = data
+                out[spec["len"]] = lens
+                out[spec["sublen"]] = subl
+                continue
             name = var.name if isinstance(var, Variable) else var
             if kind == "seq":
                 maxlen = max(len(s) for s in col)

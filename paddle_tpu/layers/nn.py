@@ -80,7 +80,8 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 
 def embedding(input, size, is_sparse=False, padding_idx=None,
-              param_attr=None, dtype="float32", name=None, **kwargs):
+              param_attr=None, dtype="float32", name=None,
+              keep_dims=False, **kwargs):
     """Embedding lookup (reference lookup_table_op). With
     ``is_sparse=True`` the table's gradient is a SelectedRows-style
     (rows, values) pair — never a dense [V, D] buffer — and
@@ -95,7 +96,8 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
                      inputs={"W": [w.name], "Ids": [input.name]},
                      outputs={"Out": [out.name]},
                      attrs={"padding_idx": padding_idx,
-                            "is_sparse": bool(is_sparse)})
+                            "is_sparse": bool(is_sparse),
+                            "keep_dims": bool(keep_dims)})
     return out
 
 

@@ -213,8 +213,10 @@ def _lookup_table(ctx):
         emb = jnp.where((flat == pad)[:, None], 0.0, emb)
     else:
         emb = jnp.take(w, flat, axis=0)
-    out_shape = (ids.shape[:-1] if ids.shape and ids.shape[-1] == 1
-                 else ids.shape) + (w.shape[1],)
+    squeeze = (not ctx.attr("keep_dims", False) and ids.shape
+               and ids.shape[-1] == 1)
+    out_shape = (ids.shape[:-1] if squeeze else ids.shape) \
+        + (w.shape[1],)
     return {"Out": emb.reshape(out_shape)}
 
 

@@ -40,10 +40,14 @@ def _length_of(var):
 
 
 def _tag(out, src):
-    """Propagate the sequence-length var through unary layers."""
+    """Propagate the sequence-length (and sub-length) vars through
+    unary layers."""
     ln = _length_of(src)
     if ln is not None:
         out._v2_length = ln
+    sl = getattr(src, "_v2_sublen", None)
+    if sl is not None:
+        out._v2_sublen = sl
     return out
 
 
@@ -58,7 +62,46 @@ def _first(input):
 # ---- data / io -------------------------------------------------------
 
 def data(name, type, **kwargs):
-    """v2 data layer: shape/dtype/sequence-ness from the InputType."""
+    """v2 data layer: shape/dtype/sequence-ness from the InputType.
+
+    Realizations (data_type.py, SURVEY §5.7 static shapes):
+    * sequence -> padded ids/values + ``name@len`` length var;
+    * sub-sequence (seq_type=2) -> [B, S, T, ...] + ``name@len`` [B] +
+      ``name@sublen`` [B, S] (ops/nested_ops.py convention);
+    * sparse pair types (sparse_float_vector*, sparse_binary_vector
+      at sequence levels) -> ragged-K ids + ``name@value`` weights
+      (all-ones for binary rows), one extra trailing K axis below the
+      sequence levels — reference SparseFloat/SparseBinaryScanner
+      (py_paddle/dataprovider_converter.py:154,184)."""
+    if getattr(type, "is_sparse_pair", False):
+        ndim = type.seq_type + 1  # K, plus one axis per seq level
+        var = _L.data(name, shape=[None] * ndim, dtype="int64",
+                      **kwargs)
+        values = _L.data(name + "@value", shape=[None] * ndim,
+                         dtype="float32", **kwargs)
+        var._v2_value = values
+        length = None
+        if type.seq_type >= 1:
+            length = _L.data(name + "@len", shape=[], dtype="int64",
+                             **kwargs)
+            var._v2_length = length
+        if type.seq_type == 2:
+            sublen = _L.data(name + "@sublen", shape=[None],
+                             dtype="int64", **kwargs)
+            var._v2_sublen = sublen
+        _input_types()[var.name] = (type, length)
+        return var
+    if getattr(type, "is_nested", False):
+        var = _L.data(name, shape=[None, None], dtype=type.dtype,
+                      **kwargs)
+        length = _L.data(name + "@len", shape=[], dtype="int64",
+                         **kwargs)
+        sublen = _L.data(name + "@sublen", shape=[None], dtype="int64",
+                         **kwargs)
+        var._v2_length = length
+        var._v2_sublen = sublen
+        _input_types()[var.name] = (type, length)
+        return var
     if type.is_seq:
         var = _L.data(name, shape=[None], dtype=type.dtype, **kwargs)
         length = _L.data(name + "@len", shape=[], dtype="int64",
@@ -79,8 +122,55 @@ def printer(input, format=None, **kwargs):
 
 # ---- core nn ---------------------------------------------------------
 
+def _sparse_float_rowsum(input, width, param_attr=None):
+    """sum_k values_k * Table[ids_k] — the sparse-row × dense-matrix
+    product of the reference's sparse_float_vector path
+    (``math/CpuSparseMatrix.h:24``, fc over sparse input) computed by
+    gather + weighted sum; the dense [B, dim] row never materializes."""
+    entry = _input_types().get(input.name)
+    if entry is None:
+        raise ValueError("sparse-float input %r has no registered "
+                         "InputType" % input.name)
+    vocab = entry[0].dim
+    rows = _L.embedding(input, size=[vocab, width],
+                        param_attr=param_attr,
+                        keep_dims=True)               # [..., K, width]
+    weighted = _L.elementwise_mul(rows, input._v2_value, axis=0)
+    # sum over K (the ragged sparse-row axis); 0-padded values make
+    # padding rows no-ops, so no mask is needed
+    return _tag(_L.reduce_sum(weighted, dim=-2), input)
+
+
 def fc(input, size, act=None, param_attr=None, bias_attr=None, **kwargs):
     inputs = input if isinstance(input, (list, tuple)) else [input]
+    if any(getattr(v, "_v2_value", None) is not None for v in inputs):
+        parts = []
+        for v in inputs:
+            if getattr(v, "_v2_value", None) is not None:
+                parts.append(_sparse_float_rowsum(v, size, param_attr))
+            else:
+                parts.append(_L.fc(
+                    v, size, bias_attr=False, param_attr=param_attr,
+                    num_flatten_dims=2 if len(v.shape or ()) >= 3
+                    else 1, **kwargs))
+        out = parts[0] if len(parts) == 1 else _L.sums(parts)
+        if bias_attr is not False:
+            from ..layer_helper import LayerHelper
+            helper = LayerHelper("fc_sparse_bias")
+            b = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                        shape=[size], dtype=out.dtype,
+                                        is_bias=True)
+            out = _L.elementwise_add(out, b)
+        act_n = _act_name(act)
+        out = getattr(_L, act_n)(out) if act_n else out
+        if act_n == "softmax":
+            out._v2_softmaxed = True
+        # bias/act wrap fresh Variables — re-tag sequence lengths so
+        # downstream pooling masks padding (first tagged input wins)
+        for v in inputs:
+            if _length_of(v) is not None:
+                return _tag(out, v)
+        return out
     ndim = max(len(v.shape or ()) for v in inputs)
     out = _L.fc(input, size, act=_act_name(act), param_attr=param_attr,
                 bias_attr=bias_attr,
@@ -389,8 +479,18 @@ def row_conv(input, context_len, act=None, param_attr=None, **kwargs):
 # ---- sequence layers -------------------------------------------------
 
 def pooling(input, pooling_type=None, **kwargs):
-    """Sequence pooling over the time axis (v2 pooling layer)."""
+    """Sequence pooling over the time axis (v2 pooling layer). On a
+    sub-sequence input ([B, S, T, ...] + sub-lengths) it pools the
+    INNERMOST level -> [B, S, ...] still tagged as an outer sequence —
+    the reference's sequence_pool over a 2-level LoD; pool again for
+    [B, ...]."""
     ptype = getattr(pooling_type, "name", None) or "max"
+    sublen = getattr(input, "_v2_sublen", None)
+    if sublen is not None:
+        out = _L.nested_sequence_pool(input, sublen, pool_type=ptype,
+                                      **kwargs)
+        out._v2_length = input._v2_length
+        return out
     return _L.sequence_pool(input, ptype, length=_length_of(input),
                             **kwargs)
 
@@ -720,6 +820,10 @@ def trans_full_matrix_projection(input, size=0, param_attr=None,
 def table_projection(input, size=0, param_attr=None, **kwargs):
     entry = _input_types().get(input.name)
     vocab = entry[0].dim if entry else None
+    if getattr(input, "_v2_value", None) is not None:
+        return _Projection(
+            lambda sz: _sparse_float_rowsum(input, sz, param_attr),
+            input)
     return _Projection(
         lambda sz: _L.embedding(input, size=[vocab, sz],
                                 param_attr=param_attr), input)

@@ -30,7 +30,20 @@ def _build_feeder(feeding, sample_width, program=None):
         if entry is None:
             raise KeyError("unknown data layer %r in feeding" % name)
         typ, length = entry
-        if typ.is_seq:
+        if getattr(typ, "is_sparse_pair", False):
+            spec = {"kind": "sparse", "name": name,
+                    "values": name + "@value", "depth": typ.seq_type}
+            if typ.seq_type >= 1:
+                spec["len"] = name + "@len"
+            if typ.seq_type == 2:
+                spec["sublen"] = name + "@sublen"
+            feed_list.append(spec)
+        elif getattr(typ, "is_nested", False):
+            feed_list.append({"kind": "nested", "name": name,
+                              "len": name + "@len",
+                              "sublen": name + "@sublen",
+                              "dtype": typ.dtype})
+        elif typ.is_seq:
             feed_list.append((name, length.name))
         else:
             feed_list.append(name)
@@ -60,6 +73,12 @@ class SGD:
             self._trainer.feeder = feeder
         self._trainer.train(reader, num_passes=num_passes,
                             event_handler=event_handler)
+
+    def save_parameter_to_tar(self, f):
+        """Save the trained parameters to an open binary file as a tar
+        checkpoint (reference ``trainer.py`` SGD.save_parameter_to_tar
+        — the v2 event-handler save idiom)."""
+        self._parameters.to_tar(f)
 
     def test(self, reader, feeding=None):
         """Mean cost over a test reader (v2 SGD.test)."""
