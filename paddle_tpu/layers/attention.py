@@ -20,12 +20,21 @@ def multi_head_attention(queries, keys, values, d_model, num_heads,
     [B, T, D]. ``ring_axis``: mesh axis name for ring (sequence-parallel)
     attention."""
     helper = LayerHelper("multi_head_attention", name=name, **kwargs)
+    # default param names carry tp-able suffixes: .qkv.* weights are
+    # column-parallel ([D, D] sharded on dim 1), .o.* row-parallel —
+    # see models.transformer.transformer_tp_rules
+    from ..core import unique_name
+    prefix = name or unique_name.generate("mha")
+
+    def attr(suffix):
+        return param_attr if param_attr is not None else \
+            "%s.%s.w" % (prefix, suffix)
     q = _nn.fc(queries, d_model, num_flatten_dims=2, bias_attr=False,
-               param_attr=param_attr, **kwargs)
+               param_attr=attr("qkv_q"), **kwargs)
     k = _nn.fc(keys, d_model, num_flatten_dims=2, bias_attr=False,
-               param_attr=param_attr, **kwargs)
+               param_attr=attr("qkv_k"), **kwargs)
     v = _nn.fc(values, d_model, num_flatten_dims=2, bias_attr=False,
-               param_attr=param_attr, **kwargs)
+               param_attr=attr("qkv_v"), **kwargs)
     inputs = {"Q": [q.name], "K": [k.name], "V": [v.name]}
     if key_length is not None:
         inputs["KeyLength"] = [key_length.name]
@@ -35,7 +44,7 @@ def multi_head_attention(queries, keys, values, d_model, num_heads,
                      attrs={"num_heads": num_heads, "causal": causal,
                             "ring_axis": ring_axis})
     return _nn.fc(ctx_out, d_model, num_flatten_dims=2, bias_attr=False,
-                  param_attr=param_attr, **kwargs)
+                  param_attr=attr("o"), **kwargs)
 
 
 def transformer_encoder_layer(x, d_model, num_heads, d_ff, causal=False,
@@ -51,8 +60,14 @@ def transformer_encoder_layer(x, d_model, num_heads, d_ff, causal=False,
         att = _nn.dropout(att, dropout_prob, is_test=is_test, **kwargs)
     x = _nn.elementwise_add(x, att, **kwargs)
     ln2 = _nn.layer_norm(x, begin_norm_axis=2, **kwargs)
-    ff = _nn.fc(ln2, d_ff, num_flatten_dims=2, act="gelu", **kwargs)
-    ff = _nn.fc(ff, d_model, num_flatten_dims=2, **kwargs)
+    from ..core import unique_name
+    prefix = name or unique_name.generate("enc")
+    ff = _nn.fc(ln2, d_ff, num_flatten_dims=2, act="gelu",
+                param_attr="%s.ffn1.w" % prefix,
+                bias_attr="%s.ffn1.b" % prefix, **kwargs)
+    ff = _nn.fc(ff, d_model, num_flatten_dims=2,
+                param_attr="%s.ffn2.w" % prefix,
+                bias_attr="%s.ffn2.b" % prefix, **kwargs)
     if dropout_prob:
         ff = _nn.dropout(ff, dropout_prob, is_test=is_test, **kwargs)
     return _nn.elementwise_add(x, ff, **kwargs)

@@ -9,7 +9,8 @@ from .. import layers
 from ..layers.attention import (transformer_encoder_layer,
                                 positional_encoding)
 
-__all__ = ["transformer_lm", "transformer_lm_generate"]
+__all__ = ["transformer_lm", "transformer_lm_generate",
+           "transformer_tp_rules"]
 
 
 def _lm_backbone(tokens, vocab_size, d_model, num_heads, d_ff, num_layers,
@@ -26,7 +27,27 @@ def _lm_backbone(tokens, vocab_size, d_model, num_heads, d_ff, num_layers,
             ring_axis=ring_axis, dropout_prob=dropout_prob,
             is_test=is_test)
     x = layers.layer_norm(x, begin_norm_axis=2)
-    return layers.fc(x, vocab_size, num_flatten_dims=2, bias_attr=False)
+    return layers.fc(x, vocab_size, num_flatten_dims=2, bias_attr=False,
+                     param_attr="lm_head.w")
+
+
+def transformer_tp_rules(model_axis="model"):
+    """Megatron-style tensor-parallel PartitionSpec rules for the
+    transformer params (fed to parallel.DistStrategy): qkv + ffn1
+    column-parallel, attention-out + ffn2 row-parallel, lm head and
+    token embedding vocab-sharded. XLA inserts the all-reduces at the
+    row-parallel seams (the scaling-book recipe)."""
+    from .. import parallel
+    P = parallel.P
+    return [
+        (r"\.qkv_[qkv]\.w$", P(None, model_axis)),
+        (r"\.o\.w$", P(model_axis, None)),
+        (r"\.ffn1\.w$", P(None, model_axis)),
+        (r"\.ffn1\.b$", P(model_axis)),
+        (r"\.ffn2\.w$", P(model_axis, None)),
+        (r"^lm_head\.w$", P(None, model_axis)),
+        (r"^tok_embedding$", P(model_axis, None)),
+    ]
 
 
 def transformer_lm(tokens, labels, vocab_size, d_model=128, num_heads=4,

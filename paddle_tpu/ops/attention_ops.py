@@ -40,23 +40,60 @@ def _multihead_attention(ctx):
         return {"Out": out.reshape(b, tq, dm)}
 
     from .. import config as _config
-    # flash kernel only outside a sharded trace: pallas_call is an
-    # opaque custom call GSPMD cannot partition (the ring path above is
-    # the sharded long-context answer). KeyLength padding masks ride
-    # the kernel's segment-id mask (round 4; VERDICT r3 weak #3).
-    if _config.get_flag("flash_attention") and tq == tk and \
-            parallel.current_strategy() is None:
+    if _config.get_flag("flash_attention") and tq == tk:
         from .pallas_attention import flash_attention
         seg = None
         if ctx.has_input("KeyLength"):
             klen = ctx.input("KeyLength").reshape(-1)
             seg = (jnp.arange(tk)[None, :] <
                    klen[:, None]).astype(jnp.int32)
-        out = flash_attention(qh.transpose(0, 2, 1, 3),
-                              kh.transpose(0, 2, 1, 3),
-                              vh.transpose(0, 2, 1, 3), causal=causal,
-                              segment_ids=seg)
-        return {"Out": out.transpose(0, 2, 1, 3).reshape(b, tq, dm)}
+        if strategy is None:
+            out = flash_attention(qh.transpose(0, 2, 1, 3),
+                                  kh.transpose(0, 2, 1, 3),
+                                  vh.transpose(0, 2, 1, 3),
+                                  causal=causal, segment_ids=seg)
+            return {"Out": out.transpose(0, 2, 1, 3).reshape(b, tq, dm)}
+        # Sharded trace: pallas_call is an opaque custom call GSPMD
+        # cannot partition, but attention is embarrassingly parallel
+        # over batch and heads — run the kernel PER-SHARD under
+        # shard_map (dp shards B, tp shards H; T stays local — the
+        # ring path above is the T-sharded long-context answer).
+        sizes = dict(zip(strategy.mesh.axis_names,
+                         strategy.mesh.devices.shape))
+        daxis = strategy.data_axis
+        if daxis is not None and b % sizes.get(daxis, 1) != 0:
+            daxis = None
+        maxis = getattr(strategy, "model_axis", None)
+        if maxis is not None and nh % sizes.get(maxis, 1) != 0:
+            maxis = None
+        if daxis is not None or maxis is not None:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as SP
+            spec = SP(daxis, maxis, None, None)
+
+            if seg is None:
+                def body(qs, ks, vs):
+                    return flash_attention(qs, ks, vs, causal=causal)
+                fn = shard_map(body, mesh=strategy.mesh,
+                               in_specs=(spec, spec, spec),
+                               out_specs=spec, check_vma=False)
+                out = fn(qh.transpose(0, 2, 1, 3),
+                         kh.transpose(0, 2, 1, 3),
+                         vh.transpose(0, 2, 1, 3))
+            else:
+                sspec = SP(daxis, None)
+
+                def body(qs, ks, vs, ss):
+                    return flash_attention(qs, ks, vs, causal=causal,
+                                           segment_ids=ss)
+                fn = shard_map(body, mesh=strategy.mesh,
+                               in_specs=(spec, spec, spec, sspec),
+                               out_specs=spec, check_vma=False)
+                out = fn(qh.transpose(0, 2, 1, 3),
+                         kh.transpose(0, 2, 1, 3),
+                         vh.transpose(0, 2, 1, 3), seg)
+            return {"Out": out.transpose(0, 2, 1, 3).reshape(b, tq, dm)}
+        # no shardable axis applies -> dense path below
 
     s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
                    preferred_element_type=jnp.float32) * (hd ** -0.5)

@@ -63,14 +63,18 @@ class DistStrategy:
 
     param_rules: list of (regex, PartitionSpec) — first match wins; unmatched
     persistable state is replicated. data_axis shards every feed's batch
-    (0th) dim.
+    (0th) dim; model_axis names the tensor-parallel axis for mesh-aware
+    ops (e.g. the flash kernel shards attention heads over it).
     """
 
     _uid_counter = [0]
 
-    def __init__(self, mesh, data_axis="data", param_rules=None):
+    def __init__(self, mesh, data_axis="data", param_rules=None,
+                 model_axis="model"):
         self.mesh = mesh
         self.data_axis = data_axis if data_axis in mesh.axis_names else None
+        self.model_axis = model_axis if model_axis in mesh.axis_names \
+            else None
         self.param_rules = [(re.compile(pat), spec)
                             for pat, spec in (param_rules or [])]
         # Monotonic uid for executor cache keys (id() can be reused post-GC).

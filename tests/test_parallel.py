@@ -94,6 +94,171 @@ def test_model_parallel_param_rule():
     np.testing.assert_allclose(out1, ref, rtol=2e-4)
 
 
+class TestTransformerUnderMesh:
+    """The pivot model under SPMD (VERDICT r4 demand 3): dp×tp
+    transformer train step == single-device step, Megatron-style tp
+    rules actually shard the qkv/out/ffn weights, and the flash kernel
+    runs under the mesh via shard_map."""
+
+    B, T, V, D, H = 8, 16, 64, 32, 4
+
+    def _build_lm(self):
+        from paddle_tpu.models.transformer import transformer_lm
+        main, startup = ptpu.Program(), ptpu.Program()
+        main.random_seed = startup.random_seed = 11
+        with ptpu.program_guard(main, startup):
+            tok = layers.data("tok", shape=[self.T], dtype="int64")
+            lbl = layers.data("lbl", shape=[self.T], dtype="int64")
+            loss, _ = transformer_lm(tok, lbl, self.V, d_model=self.D,
+                                     num_heads=self.H, d_ff=self.D * 2,
+                                     num_layers=2)
+            ptpu.optimizer.Adam(1e-3).minimize(loss,
+                                               startup_program=startup)
+        return main, startup, loss
+
+    def _feed(self):
+        rs = np.random.RandomState(5)
+        tok = rs.randint(2, self.V, (self.B, self.T)).astype("int64")
+        lbl = np.roll(tok, -1, axis=1)
+        return {"tok": tok, "lbl": lbl}
+
+    def _run_steps(self, strat, flash, n=2):
+        ptpu.config.set_flags(flash_attention=flash)
+        try:
+            with ptpu.scope_guard(ptpu.Scope()), \
+                    ptpu.unique_name.guard():
+                main, startup, loss = self._build_lm()
+                exe = ptpu.Executor(strategy=strat)
+                exe.run(startup)
+                feed = self._feed()
+                losses = [float(exe.run(main, feed=feed,
+                                        fetch_list=[loss])[0])
+                          for _ in range(n)]
+                qkv = next(k for k, _ in ptpu.global_scope().items()
+                           if k.endswith(".qkv_q.w"))
+                wq = ptpu.global_scope().find_var(qkv)
+                return losses, wq
+        finally:
+            ptpu.config.set_flags(flash_attention=False)
+
+    def test_dp_tp_matches_single_device(self):
+        from paddle_tpu.models.transformer import transformer_tp_rules
+        single, _ = self._run_steps(None, flash=False)
+        mesh = parallel.make_mesh({"data": 4, "model": 2})
+        strat = parallel.DistStrategy(
+            mesh, data_axis="data",
+            param_rules=transformer_tp_rules("model"))
+        sharded, wq = self._run_steps(strat, flash=False)
+        np.testing.assert_allclose(single, sharded, rtol=2e-3,
+                                   atol=2e-4)
+        # the qkv weight is really column-sharded over 'model'
+        assert np.asarray(wq).shape == (self.D, self.D)
+        assert wq.addressable_shards[0].data.shape == (self.D,
+                                                       self.D // 2)
+
+    def test_flash_under_mesh_matches_dense(self):
+        """flash_attention=True under dp×tp runs the Pallas kernel
+        per-shard (shard_map; interpret mode on CPU) and reproduces
+        the dense path."""
+        from paddle_tpu.models.transformer import transformer_tp_rules
+        mesh = parallel.make_mesh({"data": 4, "model": 2})
+        strat = parallel.DistStrategy(
+            mesh, data_axis="data",
+            param_rules=transformer_tp_rules("model"))
+        dense, _ = self._run_steps(strat, flash=False)
+        flash, _ = self._run_steps(strat, flash=True)
+        np.testing.assert_allclose(dense, flash, rtol=5e-3, atol=5e-4)
+
+    def test_flash_segment_mask_under_mesh(self):
+        """Packed-segment/padding masks ride the kernel under SPMD:
+        attention with KeyLength on a sharded batch == unsharded."""
+        ptpu.config.set_flags(flash_attention=True)
+        try:
+            def run(strat):
+                with ptpu.scope_guard(ptpu.Scope()), \
+                        ptpu.unique_name.guard():
+                    main, startup = ptpu.Program(), ptpu.Program()
+                    main.random_seed = startup.random_seed = 3
+                    with ptpu.program_guard(main, startup):
+                        x = layers.data("x", shape=[16, 32])
+                        ln = layers.data("len", shape=[],
+                                         dtype="int64")
+                        from paddle_tpu.layers.attention import \
+                            multi_head_attention
+                        out = layers.mean(multi_head_attention(
+                            x, x, x, 32, 4, causal=True,
+                            key_length=ln))
+                    exe = ptpu.Executor(strategy=strat)
+                    exe.run(startup)
+                    rs = np.random.RandomState(2)
+                    feed = {"x": rs.randn(8, 16, 32).astype("float32"),
+                            "len": np.array([16, 12, 8, 4] * 2,
+                                            "int64")}
+                    return np.asarray(exe.run(main, feed=feed,
+                                              fetch_list=[out])[0])
+            ref = run(None)
+            got = run(parallel.DataParallel(n_devices=8))
+            np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-5)
+        finally:
+            ptpu.config.set_flags(flash_attention=False)
+
+
+class TestRingAttentionUnderMesh:
+    """Ring (sequence-parallel) attention on the shared dp×tp mesh:
+    T sharded over an axis, forward AND gradients match dense."""
+
+    def _qkv(self, b=2, t=16, h=2, d=8, seed=0):
+        rs = np.random.RandomState(seed)
+        return [rs.randn(b, t, h, d).astype("float32") * 0.5
+                for _ in range(3)]
+
+    def test_forward_matches_dense_on_4dev_axis(self):
+        q, k, v = self._qkv()
+        mesh = parallel.make_mesh({"data": 4, "model": 2})
+        for causal in (False, True):
+            ref = parallel.dense_attention(q, k, v, causal=causal)
+            out = parallel.ring_attention(q, k, v, mesh,
+                                          axis_name="data",
+                                          causal=causal)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_grads_match_dense(self):
+        q, k, v = self._qkv(seed=4)
+        mesh = parallel.make_mesh({"data": 4, "model": 2})
+
+        def loss_ring(q, k, v):
+            o = parallel.ring_attention(q, k, v, mesh,
+                                        axis_name="data", causal=True)
+            return (o * o).sum()
+
+        def loss_dense(q, k, v):
+            o = parallel.dense_attention(q, k, v, causal=True)
+            return (o * o).sum()
+
+        gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_sharded_inputs_stay_sharded(self):
+        """Feeding T-sharded device arrays: output keeps the T
+        sharding (no gather to host-size arrays mid-graph)."""
+        from jax.sharding import NamedSharding
+        q, k, v = self._qkv(t=32, seed=7)
+        mesh = parallel.make_mesh({"data": 4, "model": 2})
+        spec = parallel.P(None, "data", None, None)
+        sh = NamedSharding(mesh, spec)
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        out = parallel.ring_attention(qs, ks, vs, mesh,
+                                      axis_name="data", causal=True)
+        assert out.sharding.spec == spec
+        ref = parallel.dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+
 def test_batch_norm_stats_are_global():
     """Cross-replica BN: sharded batch must produce identical running stats
     to single-device (SPMD global-view semantics = synced BN)."""
