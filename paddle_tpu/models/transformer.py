@@ -39,14 +39,18 @@ def transformer_tp_rules(model_axis="model"):
     row-parallel seams (the scaling-book recipe)."""
     from .. import parallel
     P = parallel.P
+    # UNANCHORED tails (like wide_deep.vocab_shard_rules): optimizer
+    # accumulators extend the param name (<param>_moment1_acc_0) and
+    # must inherit the sharding; state_sharding's shape-divisibility
+    # guard drops the axes on scalars like beta-pow accumulators.
     return [
-        (r"\.qkv_[qkv]\.w$", P(None, model_axis)),
-        (r"\.o\.w$", P(model_axis, None)),
-        (r"\.ffn1\.w$", P(None, model_axis)),
-        (r"\.ffn1\.b$", P(model_axis)),
-        (r"\.ffn2\.w$", P(model_axis, None)),
-        (r"^lm_head\.w$", P(None, model_axis)),
-        (r"^tok_embedding$", P(model_axis, None)),
+        (r"\.qkv_[qkv]\.w", P(None, model_axis)),
+        (r"\.o\.w", P(model_axis, None)),
+        (r"\.ffn1\.w", P(None, model_axis)),
+        (r"\.ffn1\.b", P(model_axis)),
+        (r"\.ffn2\.w", P(model_axis, None)),
+        (r"^lm_head\.w", P(None, model_axis)),
+        (r"^tok_embedding", P(model_axis, None)),
     ]
 
 

@@ -134,10 +134,13 @@ class TestTransformerUnderMesh:
                 losses = [float(exe.run(main, feed=feed,
                                         fetch_list=[loss])[0])
                           for _ in range(n)]
-                qkv = next(k for k, _ in ptpu.global_scope().items()
+                scope_vars = dict(ptpu.global_scope().items())
+                qkv = next(k for k in scope_vars
                            if k.endswith(".qkv_q.w"))
-                wq = ptpu.global_scope().find_var(qkv)
-                return losses, wq
+                mom = next((k for k in scope_vars
+                            if ".qkv_q.w_moment1" in k), None)
+                return losses, (scope_vars[qkv],
+                                scope_vars[mom] if mom else None)
         finally:
             ptpu.config.set_flags(flash_attention=False)
 
@@ -148,13 +151,17 @@ class TestTransformerUnderMesh:
         strat = parallel.DistStrategy(
             mesh, data_axis="data",
             param_rules=transformer_tp_rules("model"))
-        sharded, wq = self._run_steps(strat, flash=False)
+        sharded, (wq, mom) = self._run_steps(strat, flash=False)
         np.testing.assert_allclose(single, sharded, rtol=2e-3,
                                    atol=2e-4)
-        # the qkv weight is really column-sharded over 'model'
+        # the qkv weight is really column-sharded over 'model', and
+        # its Adam moment INHERITS the sharding (unanchored rules)
         assert np.asarray(wq).shape == (self.D, self.D)
         assert wq.addressable_shards[0].data.shape == (self.D,
                                                        self.D // 2)
+        assert mom is not None
+        assert mom.addressable_shards[0].data.shape == (self.D,
+                                                        self.D // 2)
 
     def test_flash_under_mesh_matches_dense(self):
         """flash_attention=True under dp×tp runs the Pallas kernel
