@@ -305,6 +305,40 @@ def test_flash_under_distributed_strategy_contract():
         assert not calls, \
             "flash ran with no divisible axis (unpartitionable)"
         assert np.isfinite(got).all()
+
+        # the op-level divisibility guard (unreachable through the
+        # executor, whose feed sharding rejects indivisible batches
+        # first, but live for direct op users): batch 6 over data=8
+        # and 3 heads over no model axis -> dense path
+        calls.clear()
+        from paddle_tpu.ops.attention_ops import _multihead_attention
+        from paddle_tpu import parallel as par
+
+        class _Shim:
+            def __init__(self, vals, attrs):
+                self._v, self._a = vals, attrs
+
+            def input(self, slot):
+                return self._v[slot]
+
+            def has_input(self, slot):
+                return slot in self._v
+
+            def attr(self, name, default=None):
+                return self._a.get(name, default)
+
+        rs = np.random.RandomState(1)
+        qv = jnp.asarray(rs.randn(6, 32, 48).astype("float32"))
+        prev = par.set_current_strategy(
+            ptpu.parallel.DistStrategy(mesh, data_axis="data"))
+        try:
+            out6 = _multihead_attention(_Shim(
+                {"Q": qv, "K": qv, "V": qv},
+                {"num_heads": 3, "causal": True}))["Out"]
+        finally:
+            par.set_current_strategy(prev)
+        assert not calls, "flash ran with an indivisible batch"
+        assert np.isfinite(np.asarray(out6)).all()
     finally:
         pa.flash_attention = orig
         ptpu.config.set_flags(flash_attention=False)
