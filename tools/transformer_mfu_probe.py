@@ -1,0 +1,225 @@
+"""Transformer-LM MFU probe (VERDICT r4 demand 4): attention fraction
+of the step, flash block-size sweep, and longer-T configs — decide
+whether 0.55 MFU is reachable or 0.51 is this chip's cap for the
+bench family.
+
+Usage (on the TPU chip):
+  python tools/transformer_mfu_probe.py --mode step [--batch 8 --seqlen 1024]
+  python tools/transformer_mfu_probe.py --mode kernel
+  python tools/transformer_mfu_probe.py --mode sweep
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+_PEAK = {"TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v4": 275e12,
+         "TPU v5p": 459e12}
+_HBM = {"TPU v5 lite": 819e9, "TPU v5e": 819e9}
+
+
+def _sync(x):
+    import jax
+    np.asarray(jax.device_get(x))
+
+
+def bench_step(batch, seqlen, d=2048, L=12, H=16, vocab=32768,
+               steps=8, warmup=2, flash=True, cost=True):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+    from paddle_tpu.models.transformer import transformer_lm
+
+    ptpu.config.set_flags(amp="bfloat16", flash_attention=flash)
+    dev = jax.devices()[0]
+    peak = _PEAK.get(dev.device_kind, 197e12)
+    hbm = _HBM.get(dev.device_kind, 819e9)
+
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            toks = layers.data("toks", shape=[seqlen], dtype="int64")
+            lbls = layers.data("lbls", shape=[seqlen], dtype="int64")
+            loss, _ = transformer_lm(toks, lbls, vocab_size=vocab,
+                                     d_model=d, num_heads=H, d_ff=4 * d,
+                                     num_layers=L)
+            opt = ptpu.optimizer.Adam(learning_rate=1e-4)
+            opt.minimize(loss, startup_program=startup)
+        n_params = sum(int(np.prod(p.shape)) for p in
+                       main.global_block().all_parameters())
+        exe = ptpu.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(2, vocab, (batch, seqlen)),
+                          dtype=jnp.int32)
+        feed = {"toks": jax.device_put(ids), "lbls": jax.device_put(ids)}
+
+        out = {"batch": batch, "T": seqlen, "flash": flash}
+        if cost:
+            try:
+                low = exe.lower(main, feed=feed, fetch_list=[loss])
+                ca = low.compile().cost_analysis()
+                out["xla_gflops"] = round(ca.get("flops", 0) / 1e9, 1)
+                out["xla_gbytes"] = round(
+                    ca.get("bytes accessed", 0) / 1e9, 2)
+                out["roofline_ms"] = round(
+                    ca.get("bytes accessed", 0) / hbm * 1e3, 1)
+            except Exception as e:
+                out["cost_err"] = str(e)[:120]
+
+        try:
+            for _ in range(warmup):
+                o = exe.run(main, feed=feed, fetch_list=[loss],
+                            return_numpy=False)
+            np.asarray(o[0])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                o = exe.run(main, feed=feed, fetch_list=[loss],
+                            return_numpy=False)
+            final = float(np.asarray(o[0]))
+            dt = (time.perf_counter() - t0) / steps
+        except Exception as e:
+            out["err"] = str(e)[:200]
+            return out
+        tok_s = batch * seqlen / dt
+        flops_per_tok = 6.0 * n_params + 6.0 * L * seqlen * d
+        out.update(ms=round(dt * 1e3, 1), tok_s=round(tok_s),
+                   mfu=round(tok_s * flops_per_tok / peak, 4),
+                   loss=round(final, 3))
+        return out
+
+
+def bench_kernel(block_q, block_k, b=8, h=16, t=1024, dd=128,
+                 causal=True, n_iter=8, bwd=True):
+    """Flash kernel fwd(+bwd) at the bench attention shape, chained
+    in-jit; block_k is applied by monkey-patching the cap in _forward
+    (it is a fixed 512 today)."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from paddle_tpu.ops import pallas_attention as pa
+
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(b, h, t, dd), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(b, h, t, dd), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(b, h, t, dd), jnp.bfloat16)
+
+    orig_forward = pa._forward
+
+    def patched(q_, k_, v_, seg, causal_, bq_, interpret):
+        bh, t_, d_ = q_.shape
+        bq = pa._block_size(t_, block_q)
+        bk = pa._block_size(t_, block_k)
+        if not bq or not bk:
+            return pa._reference(q_, k_, v_, causal_, seg)
+        import functools as ft
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        grid = (bh, t_ // bq, t_ // bk)
+        kw = dict(scale=d_ ** -0.5, causal=causal_, block_q=bq,
+                  block_k=bk, nk=t_ // bk)
+        return pl.pallas_call(
+            ft.partial(pa._kernel, **kw),
+            in_specs=[
+                pl.BlockSpec((1, bq, d_), lambda b2, i, j: (b2, i, 0)),
+                pl.BlockSpec((1, bk, d_), lambda b2, i, j: (b2, j, 0)),
+                pl.BlockSpec((1, bk, d_), lambda b2, i, j: (b2, j, 0))],
+            out_shape=jax.ShapeDtypeStruct((bh, t_, d_), q_.dtype),
+            grid=grid,
+            out_specs=pl.BlockSpec((1, bq, d_),
+                                   lambda b2, i, j: (b2, i, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bq, d_), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32)],
+            interpret=interpret)(q_, k_, v_)
+
+    pa._forward = patched
+    try:
+        # the chain must CONSUME every output (a *0 or dead gk/gv lets
+        # XLA DCE the work) and re-inject a scalar so iterations
+        # serialize without changing the values materially
+        if bwd:
+            def loss_fn(q_, k_, v_):
+                o = pa.flash_attention(q_, k_, v_, causal=causal)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            g = jax.grad(loss_fn, argnums=(0, 1, 2))
+
+            @jax.jit
+            def chain(q_, k_, v_):
+                def body(c, _):
+                    gq, gk, gv = g(q_ + c.astype(q_.dtype), k_, v_)
+                    s = (jnp.sum(gq.astype(jnp.float32)) +
+                         jnp.sum(gk.astype(jnp.float32)) +
+                         jnp.sum(gv.astype(jnp.float32)))
+                    return s * 1e-30, None
+                c, _ = jax.lax.scan(body, jnp.float32(0), None,
+                                    length=n_iter)
+                return c
+            _sync(chain(q, k, v))
+            t0 = time.perf_counter()
+            _sync(chain(q, k, v))
+            ms = (time.perf_counter() - t0) / n_iter * 1e3
+        else:
+            @jax.jit
+            def chain_f(q_, k_, v_):
+                def body(c, _):
+                    o = pa.flash_attention(q_ + c.astype(q_.dtype),
+                                           k_, v_, causal=causal)
+                    return jnp.sum(o.astype(jnp.float32)) * 1e-30, None
+                c, _ = jax.lax.scan(body, jnp.float32(0), None,
+                                    length=n_iter)
+                return c
+            _sync(chain_f(q, k, v))
+            t0 = time.perf_counter()
+            _sync(chain_f(q, k, v))
+            ms = (time.perf_counter() - t0) / n_iter * 1e3
+    except Exception as e:
+        pa._forward = orig_forward
+        return {"block_q": block_q, "block_k": block_k,
+                "err": str(e)[:160]}
+    finally:
+        pa._forward = orig_forward
+    # causal useful flops: ~half the full T^2 (counted full both ways
+    # in MFU conventions; report raw time, that's what matters)
+    return {"block_q": block_q, "block_k": block_k, "bwd": bwd,
+            "ms": round(ms, 2)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="step",
+                    choices=["step", "kernel", "sweep", "configs"])
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seqlen", type=int, default=1024)
+    ap.add_argument("--no-flash", action="store_true")
+    args = ap.parse_args()
+
+    if args.mode == "step":
+        print(json.dumps(bench_step(args.batch, args.seqlen,
+                                    flash=not args.no_flash)),
+              flush=True)
+    elif args.mode == "configs":
+        for b, t in [(8, 1024), (4, 2048), (2, 4096), (6, 1536),
+                     (12, 1024)]:
+            print(json.dumps(bench_step(b, t)), flush=True)
+    elif args.mode == "kernel":
+        for bwd in (False, True):
+            print(json.dumps(bench_kernel(256, 512, bwd=bwd)),
+                  flush=True)
+    elif args.mode == "sweep":
+        for bq in (256, 512, 1024):
+            for bk in (256, 512, 1024):
+                print(json.dumps(bench_kernel(bq, bk, bwd=False)),
+                      flush=True)
+
+
+if __name__ == "__main__":
+    main()
