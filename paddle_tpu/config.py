@@ -23,6 +23,12 @@ amp: None or 'bfloat16'. Mixed-precision policy applied by the executor at
   Motivation (measured, see PROFILE.md): the f32 ResNet-50 train step
   moves ~140 GB HBM/step at batch 256 and is bandwidth-bound on a TPU
   v5e (~819 GB/s); bf16 activations halve that.
+
+telemetry: if True, arm the observability layer (observability/):
+  executor compile-cache + cost-analysis metrics, trainer step-latency/
+  throughput metrics, staging queue/arena gauges, and host trace spans
+  into the Chrome-trace ring buffer. Off (default), the per-step cost of
+  the instrumentation is a flag check — no spans, no metric updates.
 """
 
 import jax
@@ -34,7 +40,12 @@ _flags = {
     # Pallas fused attention kernel for multihead_attention (see
     # ops/pallas_attention.py); interpret-mode off-TPU
     "flash_attention": False,
+    "telemetry": False,
 }
+
+# Observers called with the flag dict after every set_flags (the
+# observability package arms/disarms its tracer through this).
+_on_change = []
 
 
 def set_flags(**kwargs):
@@ -42,6 +53,8 @@ def set_flags(**kwargs):
         if k not in _flags:
             raise KeyError("unknown flag %r (have %s)" % (k, sorted(_flags)))
         _flags[k] = v
+    for cb in list(_on_change):
+        cb(_flags)
 
 
 def get_flag(name):

@@ -19,6 +19,9 @@ Differences from the reference, by design:
   one XLA computation.
 """
 
+import itertools
+import time
+
 import numpy as np
 
 import jax
@@ -28,10 +31,63 @@ from . import registry
 from .framework import (Program, Variable, default_main_program,
                         convert_dtype, RNG_STATE_VAR)
 from .scope import global_scope
+from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
 
 EMPTY_VAR = "@EMPTY@"
 
 __all__ = ["Executor", "EMPTY_VAR"]
+
+# Compile-cache + per-step cost telemetry (hooks gated by the config
+# flag "telemetry"; family creation here is one-time and free).
+_CACHE_HITS = _metrics.REGISTRY.counter(
+    "paddle_executor_cache_hits_total",
+    "Executor.run compile-cache hits")
+_CACHE_MISSES = _metrics.REGISTRY.counter(
+    "paddle_executor_cache_misses_total",
+    "Executor.run compile-cache misses (trace + XLA compile)")
+_TRACE_SECONDS = _metrics.REGISTRY.gauge(
+    "paddle_executor_trace_seconds",
+    "Python block trace + StableHLO lowering wall time per "
+    "compile-cache key",
+    labelnames=("key",))
+_COMPILE_SECONDS = _metrics.REGISTRY.gauge(
+    "paddle_executor_compile_seconds",
+    "XLA compile wall time per compile-cache key",
+    labelnames=("key",))
+_STEP_FLOPS = _metrics.REGISTRY.gauge(
+    "paddle_executor_step_flops",
+    "XLA cost-analysis FLOPs of the cached step (MFU numerator)",
+    labelnames=("key",))
+_STEP_BYTES = _metrics.REGISTRY.gauge(
+    "paddle_executor_step_bytes",
+    "XLA cost-analysis bytes accessed of the cached step "
+    "(bandwidth-roofline numerator)",
+    labelnames=("key",))
+
+
+# Global key_id source: labels must not alias across Executors or
+# threads (itertools.count.__next__ is atomic under the GIL).
+_KEY_IDS = itertools.count(1)
+
+
+class _CacheEntry:
+    """One compile-cache slot: the jitted callable, io signature, and —
+    when telemetry AOT-compiled the step — the jax.stages.Compiled
+    executable (avoids the double-compile the jit call path would pay
+    after a cost-analysis compile)."""
+
+    __slots__ = ("fn", "read", "written", "needs_rng", "key_id", "aot",
+                 "aot_failed")
+
+    def __init__(self, fn, read, written, needs_rng, key_id):
+        self.fn = fn
+        self.read = read
+        self.written = written
+        self.needs_rng = needs_rng
+        self.key_id = key_id
+        self.aot = None
+        self.aot_failed = False
 
 
 def _lookup(env, name, op, block):
@@ -248,9 +304,12 @@ class Executor:
         self.strategy = strategy
         self._cache = {}
 
-    def _prepare(self, program, feed, fetch_list, scope, donate_state):
+    def _prepare(self, program, feed, fetch_list, scope, donate_state,
+                 count_cache=True):
         """Shared run/lower prep: compile-cache lookup + state assembly.
-        Returns (fn, state_rw, state_ro, feed_arrays)."""
+        Returns (entry, state_rw, state_ro, feed_arrays). ``count_cache``
+        is False for non-step callers (lower) so the hit/miss telemetry
+        counts executed steps only."""
         if program is None:
             program = default_main_program()
         if not isinstance(program, Program):
@@ -284,24 +343,29 @@ class Executor:
                bool(donate_state),
                self.strategy._uid if self.strategy is not None else None,
                check_nan_inf, amp, flash, precision)
-        compiled = self._cache.get(key)
-        if compiled is None:
-            compiled = self._build(program, block, feed_sig, fetch_names,
-                                   donate_state, check_nan_inf, amp)
-            self._cache[key] = compiled
-        fn, read_names, written_names, needs_rng = compiled
+        telemetry = bool(_config.get_flag("telemetry"))
+        entry = self._cache.get(key)
+        if entry is None:
+            if telemetry and count_cache:
+                _CACHE_MISSES.inc()
+            built = self._build(program, block, feed_sig, fetch_names,
+                                donate_state, check_nan_inf, amp)
+            entry = _CacheEntry(*built, key_id="k%d" % next(_KEY_IDS))
+            self._cache[key] = entry
+        elif telemetry and count_cache:
+            _CACHE_HITS.inc()
 
         state_rw, state_ro = {}, {}
-        for n in written_names:
+        for n in entry.written:
             if scope.has_var(n):
                 state_rw[n] = scope.find_var(n)
-        for n in read_names:
+        for n in entry.read:
             if n in state_rw:
                 continue
             if scope.has_var(n):
                 state_ro[n] = scope.find_var(n)
             # else: executor raises at trace time with a clear message
-        if needs_rng:
+        if entry.needs_rng:
             if not scope.has_var(RNG_STATE_VAR):
                 seed = program.random_seed if program.random_seed else 0
                 scope.set_var(RNG_STATE_VAR, jax.random.PRNGKey(seed))
@@ -317,7 +381,7 @@ class Executor:
                         for n, a in state_rw.items()}
             state_ro = {n: self.strategy.shard_state(n, a)
                         for n, a in state_ro.items()}
-        return fn, state_rw, state_ro, feed_arrays
+        return entry, state_rw, state_ro, feed_arrays
 
     def lower(self, program=None, feed=None, fetch_list=None, scope=None,
               donate_state=True):
@@ -326,18 +390,72 @@ class Executor:
         Returns the ``jax.stages.Lowered`` — ``.compile()`` then
         ``.cost_analysis()`` / ``.as_text()`` for profiling and
         compile-checks of the true step module."""
-        fn, state_rw, state_ro, feed_arrays = self._prepare(
-            program, feed, fetch_list, scope, donate_state)
-        return fn.lower(state_rw, state_ro, feed_arrays)
+        entry, state_rw, state_ro, feed_arrays = self._prepare(
+            program, feed, fetch_list, scope, donate_state,
+            count_cache=False)
+        return entry.fn.lower(state_rw, state_ro, feed_arrays)
+
+    def _aot_compile(self, entry, state_rw, state_ro, feed_arrays):
+        """Telemetry path for a compile-cache miss: AOT-compile the step
+        (the jit call path would compile the same module again — the AOT
+        executable is kept and used for every subsequent run), record
+        per-key trace and compile wall time plus the XLA cost analysis
+        (FLOPs / bytes accessed — the MFU and bandwidth-roofline
+        numerators, cf. tools/mfu_probe.py)."""
+        t0 = time.perf_counter()
+        with _tracing.span("executorTrace", key=entry.key_id):
+            lowered = entry.fn.lower(state_rw, state_ro, feed_arrays)
+        t1 = time.perf_counter()
+        _TRACE_SECONDS.labels(key=entry.key_id).set(t1 - t0)
+        with _tracing.span("executorCompile", key=entry.key_id):
+            compiled = lowered.compile()
+        _COMPILE_SECONDS.labels(key=entry.key_id).set(
+            time.perf_counter() - t1)
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0] if ca else {}
+            _STEP_FLOPS.labels(key=entry.key_id).set(
+                float(ca.get("flops", 0.0)))
+            _STEP_BYTES.labels(key=entry.key_id).set(
+                float(ca.get("bytes accessed", 0.0)))
+        except Exception:
+            pass  # cost analysis is best-effort (backend-dependent)
+        entry.aot = compiled
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, donate_state=True):
         if scope is None:
             scope = global_scope()
-        fn, state_rw, state_ro, feed_arrays = self._prepare(
+        entry, state_rw, state_ro, feed_arrays = self._prepare(
             program, feed, fetch_list, scope, donate_state)
-
-        new_state, fetches, guards = fn(state_rw, state_ro, feed_arrays)
+        from .. import config as _config
+        if entry.aot is None and not entry.aot_failed and \
+                self.strategy is None and _config.get_flag("telemetry"):
+            # telemetry on and the step not yet AOT-compiled (fresh
+            # miss, or the entry predates telemetry / came from a
+            # lower() call): compile AOT so cost analysis and the
+            # executed step share ONE XLA compilation
+            try:
+                self._aot_compile(entry, state_rw, state_ro, feed_arrays)
+            except Exception:
+                entry.aot = None
+                entry.aot_failed = True  # jit call path from here on
+        if entry.aot is not None:
+            try:
+                new_state, fetches, guards = entry.aot(
+                    state_rw, state_ro, feed_arrays)
+            except (TypeError, ValueError):
+                # aval drift vs the AOT signature (e.g. a scope var was
+                # replaced with a new shape): jit retraces, AOT can't —
+                # and would flap if recompiled, so stay on jit for good
+                entry.aot = None
+                entry.aot_failed = True
+                new_state, fetches, guards = entry.fn(
+                    state_rw, state_ro, feed_arrays)
+        else:
+            new_state, fetches, guards = entry.fn(
+                state_rw, state_ro, feed_arrays)
         for n, v in new_state.items():
             scope.set_var(n, v)
         if return_numpy:

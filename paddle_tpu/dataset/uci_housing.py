@@ -21,7 +21,7 @@ def _synth(split, n):
         s = common.Synthesizer("uci_housing", split, n)
         for _ in range(n):
             x = s.rs.randn(13).astype("float32")
-            y = float(x @ _W + 0.1 * s.rs.randn())
+            y = float((x @ _W)[0] + 0.1 * s.rs.randn())
             yield x, np.array([y], dtype="float32")
     return reader
 

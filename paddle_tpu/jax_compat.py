@@ -1,0 +1,28 @@
+"""Shims over jax API drift so one source tree spans jax versions.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the ``jax``
+top level, and its replication-check kwarg was renamed
+``check_rep`` -> ``check_vma`` along the way. Callers here use the new
+spelling; the shim translates for older jax.
+"""
+
+import inspect
+
+try:  # jax >= 0.6: top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# The kwarg rename and the top-level promotion happened in different
+# releases — detect the accepted name from the signature, not the
+# import path.
+_CHECK_KW = "check_vma" if "check_vma" in inspect.signature(
+    _shard_map).parameters else "check_rep"
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    kwargs = {} if check_vma is None else {_CHECK_KW: check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

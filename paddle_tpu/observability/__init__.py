@@ -1,0 +1,45 @@
+"""Unified telemetry: metrics registry + Chrome-trace spans.
+
+One subsystem feeds three consumers:
+
+* ``metrics``  — counters/gauges/histograms with labels; Prometheus text
+  (``metrics.REGISTRY.expose_text()``) and JSON
+  (``metrics.REGISTRY.dump_json()``) exposition. The legacy
+  ``utils.stat.StatSet`` table is a view over this registry.
+* ``tracing``  — nestable host spans -> Chrome trace-event JSON
+  (``tracing.emit_chrome_trace(path)``), Perfetto-loadable next to the
+  jax.profiler device trace.
+* instrumentation hooks in ``core.executor`` (compile-cache hits/misses,
+  per-key compile wall time + XLA FLOPs/bytes), ``trainer`` (step-latency
+  histogram, examples/sec, checkpoint time, periodic structured log), and
+  ``reader.staging`` (queue depth, arena gauges).
+
+All hooks are gated by the config flag ``telemetry``
+(``config.set_flags(telemetry=True)``); disabled, the per-step cost is a
+flag check. Setting the flag also arms the span ring buffer, so
+``timer()``/``RecordEvent`` call sites across the codebase record trace
+events with no further setup.
+"""
+
+from . import metrics  # noqa: F401
+from . import tracing  # noqa: F401
+
+
+def enabled():
+    """The ``telemetry`` config-flag state (metric hooks armed?)."""
+    from .. import config
+    return bool(config.get_flag("telemetry"))
+
+
+def _on_flags_changed(flags):
+    tracing._TRACER.set_flag(flags.get("telemetry", False))
+
+
+def _install_config_hook():
+    from .. import config
+    if _on_flags_changed not in config._on_change:
+        config._on_change.append(_on_flags_changed)
+    _on_flags_changed(config._flags)
+
+
+_install_config_hook()

@@ -1,0 +1,323 @@
+"""Metrics registry: counters, gauges, and fixed-bucket histograms.
+
+The machine-readable successor of the legacy ``StatSet`` table
+(``paddle/utils/Stat.h:230-263`` prints; this exports): every metric is a
+named *family* with typed children per label-set, exposable as
+Prometheus text (``expose_text``) or JSON (``dump_json``). The legacy
+``utils.stat.StatSet`` is a view over this registry, so ``timer()`` call
+sites and the printable ``report()`` table keep working while the same
+numbers flow to scrapers.
+
+Recording is lock-cheap (one registry RLock around dict/float updates) and
+allocation-free after the first ``labels()`` resolution — hot paths should
+hold the child, not re-resolve labels per event.
+"""
+
+import json
+import math
+import threading
+
+__all__ = ["Registry", "Counter", "Gauge", "Histogram",
+           "REGISTRY", "default_registry", "DEFAULT_TIME_BUCKETS"]
+
+# Latency buckets in seconds: 500us .. 60s, wide enough for both a CPU
+# test step and a tunneled-H2D TPU step (PROFILE.md measures both).
+DEFAULT_TIME_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                        0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def _format_value(v):
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer():
+        return "%d" % int(v)
+    return repr(float(v))
+
+
+def _escape_label(v):
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+                 .replace("\n", "\\n")
+
+
+def _label_suffix(labels, extra=None):
+    items = list(labels.items()) + list((extra or {}).items())
+    if not items:
+        return ""
+    return "{%s}" % ",".join('%s="%s"' % (k, _escape_label(v))
+                             for k, v in items)
+
+
+class Counter:
+    """Monotonic count; ``inc`` only."""
+
+    __slots__ = ("labels_dict", "_value", "_lock")
+
+    def __init__(self, labels, lock):
+        self.labels_dict = labels
+        self._value = 0.0
+        self._lock = lock
+
+    def inc(self, amount=1.0):
+        if amount < 0:
+            raise ValueError("counters only go up (inc %r)" % (amount,))
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value; ``set``/``inc``/``dec``."""
+
+    __slots__ = ("labels_dict", "_value", "_lock")
+
+    def __init__(self, labels, lock):
+        self.labels_dict = labels
+        self._value = 0.0
+        self._lock = lock
+
+    def set(self, value):
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount=1.0):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount=1.0):
+        self.inc(-amount)
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram; also tracks min/max so the legacy StatSet
+    report (count/total/avg/max/min) reads straight off it."""
+
+    __slots__ = ("labels_dict", "buckets", "bucket_counts", "count", "sum",
+                 "vmin", "vmax", "_lock")
+
+    def __init__(self, labels, lock, buckets):
+        self.labels_dict = labels
+        self.buckets = buckets  # sorted upper bounds, +Inf implicit
+        self.bucket_counts = [0] * (len(buckets) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+        self._lock = lock
+
+    def observe(self, value):
+        v = float(value)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if v < self.vmin:
+                self.vmin = v
+            if v > self.vmax:
+                self.vmax = v
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    self.bucket_counts[i] += 1
+                    return
+            self.bucket_counts[-1] += 1
+
+    def cumulative_buckets(self):
+        """[(upper_bound, cumulative_count)] ending with (+Inf, count)."""
+        out, running = [], 0
+        for ub, c in zip(self.buckets, self.bucket_counts):
+            running += c
+            out.append((ub, running))
+        out.append((math.inf, running + self.bucket_counts[-1]))
+        return out
+
+
+class Family:
+    """One named metric with typed children per label-values tuple."""
+
+    def __init__(self, name, kind, help_text, labelnames, lock,
+                 buckets=None):
+        self.name = name
+        self.kind = kind
+        self.help = help_text
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(sorted(buckets)) if buckets else None
+        self._lock = lock
+        self._children = {}
+
+    def _make_child(self, labels):
+        if self.kind == "counter":
+            return Counter(labels, self._lock)
+        if self.kind == "gauge":
+            return Gauge(labels, self._lock)
+        return Histogram(labels, self._lock, self.buckets)
+
+    def labels(self, **kv):
+        if set(kv) != set(self.labelnames):
+            raise ValueError("metric %r takes labels %s, got %s"
+                             % (self.name, self.labelnames, sorted(kv)))
+        key = tuple(str(kv[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child(dict(zip(self.labelnames, key)))
+                self._children[key] = child
+            return child
+
+    def children(self):
+        with self._lock:
+            return dict(self._children)
+
+    def remove(self, **kv):
+        """Drop children whose labels match every given key=value."""
+        with self._lock:
+            for key in [k for k, c in self._children.items()
+                        if all(c.labels_dict.get(n) == str(v)
+                               for n, v in kv.items())]:
+                del self._children[key]
+
+    # label-less families act as their own single child
+    def _default(self):
+        return self.labels()
+
+    def inc(self, amount=1.0):
+        self._default().inc(amount)
+
+    def set(self, value):
+        self._default().set(value)
+
+    def dec(self, amount=1.0):
+        self._default().dec(amount)
+
+    def observe(self, value):
+        self._default().observe(value)
+
+    @property
+    def value(self):
+        return self._default().value
+
+
+class Registry:
+    """Named families; idempotent creation, mismatched re-creation raises."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families = {}
+        # bumped by reset(); holders of cached children (utils.stat)
+        # compare it to drop stale references
+        self.generation = 0
+
+    def _get_or_create(self, name, kind, help_text, labelnames, buckets):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind or fam.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        "metric %r re-registered as %s%s (was %s%s)"
+                        % (name, kind, tuple(labelnames), fam.kind,
+                           fam.labelnames))
+                return fam
+            fam = Family(name, kind, help_text, labelnames, self._lock,
+                         buckets=buckets)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name, help_text="", labelnames=()):
+        return self._get_or_create(name, "counter", help_text, labelnames,
+                                   None)
+
+    def gauge(self, name, help_text="", labelnames=()):
+        return self._get_or_create(name, "gauge", help_text, labelnames,
+                                   None)
+
+    def histogram(self, name, help_text="", labelnames=(),
+                  buckets=DEFAULT_TIME_BUCKETS):
+        return self._get_or_create(name, "histogram", help_text, labelnames,
+                                   buckets)
+
+    def families(self):
+        with self._lock:
+            return dict(self._families)
+
+    def reset(self):
+        """Drop every child (families stay registered, handles stay valid
+        for label-less access; held children keep counting into dropped
+        objects, so re-resolve after a reset — ``generation`` is bumped
+        so caching holders can detect this)."""
+        with self._lock:
+            for fam in self._families.values():
+                fam._children.clear()
+            self.generation += 1
+
+    # -- exposition ------------------------------------------------------
+    def expose_text(self):
+        """Prometheus text exposition format 0.0.4."""
+        lines = []
+        for name in sorted(self.families()):
+            fam = self._families[name]
+            children = fam.children()
+            if not children:
+                continue
+            if fam.help:
+                lines.append("# HELP %s %s" % (name, fam.help))
+            lines.append("# TYPE %s %s" % (name, fam.kind))
+            for key in sorted(children):
+                child = children[key]
+                labels = child.labels_dict
+                if fam.kind == "histogram":
+                    for ub, cum in child.cumulative_buckets():
+                        lines.append("%s_bucket%s %d" % (
+                            name, _label_suffix(labels,
+                                                {"le": _format_value(ub)}),
+                            cum))
+                    lines.append("%s_sum%s %s" % (
+                        name, _label_suffix(labels),
+                        repr(float(child.sum))))
+                    lines.append("%s_count%s %d" % (
+                        name, _label_suffix(labels), child.count))
+                else:
+                    lines.append("%s%s %s" % (
+                        name, _label_suffix(labels),
+                        _format_value(child.value)))
+        return "\n".join(lines) + "\n"
+
+    def dump(self):
+        """JSON-ready dict: {name: {type, help, samples: [...]}}."""
+        out = {}
+        for name, fam in sorted(self.families().items()):
+            samples = []
+            children = fam.children()
+            for key in sorted(children):
+                child = children[key]
+                if fam.kind == "histogram":
+                    samples.append({
+                        "labels": child.labels_dict,
+                        "count": child.count,
+                        "sum": child.sum,
+                        "min": None if child.count == 0 else child.vmin,
+                        "max": None if child.count == 0 else child.vmax,
+                        "buckets": {_format_value(ub): cum for ub, cum
+                                    in child.cumulative_buckets()},
+                    })
+                else:
+                    samples.append({"labels": child.labels_dict,
+                                    "value": child.value})
+            out[name] = {"type": fam.kind, "help": fam.help,
+                         "samples": samples}
+        return out
+
+    def dump_json(self, indent=None):
+        return json.dumps(self.dump(), indent=indent, sort_keys=True)
+
+
+REGISTRY = Registry()
+
+
+def default_registry():
+    return REGISTRY

@@ -67,7 +67,7 @@ def _multihead_attention(ctx):
         if maxis is not None and nh % sizes.get(maxis, 1) != 0:
             maxis = None
         if daxis is not None or maxis is not None:
-            from jax import shard_map
+            from ..jax_compat import shard_map
             from jax.sharding import PartitionSpec as SP
             spec = SP(daxis, maxis, None, None)
 

@@ -28,15 +28,46 @@ use.
 
 import collections
 import ctypes
+import itertools
 import queue as _queue
 import threading
 import time
 
 import numpy as np
 
+from .. import config as _config
+from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
+
 __all__ = ["StagedReader"]
 
 _END = object()
+
+# Input-pipeline telemetry (recording gated by the "telemetry" flag):
+# live queue/arena gauges in the registry replace the one-shot
+# set_gauges snapshot the trainer used to take at teardown. Gauges are
+# labeled per reader instance so concurrent StagedReaders don't
+# clobber each other; the counter/histogram are additive and global.
+_QUEUE_DEPTH = _metrics.REGISTRY.gauge(
+    "paddle_staging_queue_depth",
+    "Staged batches queued ahead of the consumer, per reader",
+    labelnames=("reader",))
+_STAGED_TOTAL = _metrics.REGISTRY.counter(
+    "paddle_staging_batches_total", "Batches staged (all readers)")
+_STAGE_SECONDS = _metrics.REGISTRY.histogram(
+    "paddle_staging_stage_seconds",
+    "Per-batch staging time: reader pull + feeder + arena copy + "
+    "device_put dispatch")
+_ARENA_IN_USE = _metrics.REGISTRY.gauge(
+    "paddle_staging_arena_in_use_bytes",
+    "Buddy-arena bytes currently allocated to in-flight batches, "
+    "per reader",
+    labelnames=("reader",))
+_ARENA_PEAK = _metrics.REGISTRY.gauge(
+    "paddle_staging_arena_peak_bytes",
+    "Buddy-arena high-water mark, per reader",
+    labelnames=("reader",))
+_READER_IDS = itertools.count(1)
 
 
 class _Arena:
@@ -99,6 +130,7 @@ class StagedReader:
         self.records = collections.deque(maxlen=1024)
         self.staged_batches = 0
         self.arena_active = False
+        self._tel_label = "r%d" % next(_READER_IDS)
         self._arena = None
         self._active = None    # (thread, stop, queue) of a live fill
         # The arena only serves the device_put path: each block is read
@@ -164,15 +196,30 @@ class StagedReader:
                     batch = next(it)
                 except StopIteration:
                     break
-                feed = self.feeder.feed(batch) if self.feeder else batch
-                staged, ptrs = self._stage_feed(feed)
-                self.records.append((t0, time.perf_counter()))
+                with _tracing.span("stageBatch"):
+                    feed = self.feeder.feed(batch) if self.feeder \
+                        else batch
+                    staged, ptrs = self._stage_feed(feed)
+                t1 = time.perf_counter()
+                self.records.append((t0, t1))
                 self.staged_batches += 1
+                if _config.get_flag("telemetry"):
+                    _STAGED_TOTAL.inc()
+                    _STAGE_SECONDS.observe(t1 - t0)
+                    self._update_gauges(q)
                 q.put((staged, ptrs))
         except Exception as e:  # surface in the consumer
             q.put(e)
         finally:
             q.put(_END)
+
+    def _update_gauges(self, q):
+        _QUEUE_DEPTH.labels(reader=self._tel_label).set(q.qsize())
+        if self._arena is not None:
+            _ARENA_IN_USE.labels(reader=self._tel_label).set(
+                self._arena.in_use())
+            _ARENA_PEAK.labels(reader=self._tel_label).set(
+                self._arena.peak())
 
     # -- consumer --------------------------------------------------------
     def __call__(self):
@@ -191,6 +238,8 @@ class StagedReader:
                 if isinstance(item, Exception):
                     raise item
                 staged, ptrs = item
+                if _config.get_flag("telemetry"):
+                    self._update_gauges(q)
                 # recycle arena blocks free_lag batches behind, and only
                 # once the batch's own H2D transfers have completed — the
                 # lag keeps this non-blocking in steady state, the

@@ -13,17 +13,54 @@ the reader is wrapped in a host-side prefetch buffer to overlap input with
 device steps (the async double-buffer DataProvider analog).
 """
 
+import itertools
+import time
+
 import numpy as np
 
+from . import config as _config
 from . import io as _io
 from . import reader as _reader
 from .core.executor import Executor
 from .core.framework import default_main_program, default_startup_program
 from .core.scope import global_scope
+from .observability import metrics as _metrics
+from .observability import tracing as _tracing
+from .utils import log as _log
 from .utils.stat import timer, stat_set
 
 __all__ = ["Trainer", "BeginPass", "EndPass", "BeginIteration",
            "EndIteration"]
+
+# Step telemetry (recording gated by the config flag "telemetry").
+_STEP_SECONDS = _metrics.REGISTRY.histogram(
+    "paddle_trainer_step_seconds",
+    "Host wall time per train step. With async_metrics this is "
+    "dispatch time, NOT device latency (PROFILE.md sync rule) — use "
+    "examples_per_second (cumulative, sync-independent) for throughput")
+_EXAMPLES_TOTAL = _metrics.REGISTRY.counter(
+    "paddle_trainer_examples_total", "Examples consumed by train steps")
+_EXAMPLES_PER_SEC = _metrics.REGISTRY.gauge(
+    "paddle_trainer_examples_per_second",
+    "Cumulative throughput per trainer: examples / wall time since "
+    "that Trainer's first step (valid under async dispatch — no "
+    "per-step host sync)",
+    labelnames=("trainer",))
+_TRAINER_IDS = itertools.count(1)
+_STEPS_TOTAL = _metrics.REGISTRY.counter(
+    "paddle_trainer_steps_total", "Optimizer steps taken")
+_CKPT_SECONDS = _metrics.REGISTRY.histogram(
+    "paddle_trainer_checkpoint_seconds", "Checkpoint save wall time")
+
+
+def _batch_size(feed):
+    """Largest leading dim across feed arrays (examples in this step)."""
+    n = 0
+    for v in feed.values():
+        shape = getattr(v, "shape", None)
+        if shape:
+            n = max(n, int(shape[0]))
+    return n
 
 
 class BeginPass:
@@ -59,13 +96,16 @@ class Trainer:
     def __init__(self, loss, optimizer=None, feeder=None, metrics=None,
                  main_program=None, startup_program=None, strategy=None,
                  checkpoint_dir=None, checkpoint_every_n_steps=None,
-                 scheduler=None, place=None, async_metrics=False):
+                 scheduler=None, place=None, async_metrics=False,
+                 periodic_log_interval=None):
         """metrics: {name: Variable} fetched each batch alongside loss.
         feeder: DataFeeder (or None — reader yields feed dicts directly).
         async_metrics: keep per-batch metric fetches as device arrays —
         no host sync per step, so the train loop runs dispatch-ahead
         (the throughput recipe, PROFILE.md sink #1); event handlers can
         still np.asarray() a metric when they actually need the value.
+        periodic_log_interval: with the ``telemetry`` flag on, emit one
+        structured throughput line (utils.log.structured) every N steps.
         """
         self.loss = loss
         self.main_program = main_program or default_main_program()
@@ -78,8 +118,15 @@ class Trainer:
         self.checkpoint_every = checkpoint_every_n_steps
         self.scheduler = scheduler
         self.async_metrics = async_metrics
+        self.periodic_log_interval = periodic_log_interval
         self.step_id = 0
         self._initialized = False
+        # telemetry window: (first-step start time, examples since);
+        # the throughput gauge is per-instance ("trainer" label) — two
+        # Trainers must not clobber one label-less value
+        self._tel_t0 = None
+        self._tel_examples = 0
+        self._tel_label = "t%d" % next(_TRAINER_IDS)
 
     # -- lifecycle -----------------------------------------------------------
     def startup(self):
@@ -101,30 +148,75 @@ class Trainer:
 
     def train_batch(self, batch):
         """One donated-step train batch; returns {metric: value}."""
-        feed = self.feeder.feed(batch) if self.feeder else batch
+        if _config.get_flag("telemetry"):
+            with timer("feed"):
+                feed = self.feeder.feed(batch) if self.feeder else batch
+        else:
+            feed = self.feeder.feed(batch) if self.feeder else batch
         return self._train_feed(feed)
 
     def _train_feed(self, feed):
         """One step from an already-assembled feed dict."""
         self.startup()
         names, vars_ = self._fetches()
+        telemetry = _config.get_flag("telemetry")
+        t0 = time.perf_counter() if telemetry else 0.0
         with timer("trainOneBatch"):
             vals = self.exe.run(self.main_program, feed=feed,
                                 fetch_list=vars_,
                                 return_numpy=not self.async_metrics)
+        if telemetry:
+            self._record_step(feed, t0, time.perf_counter())
         self.step_id += 1
         if self.scheduler is not None:
             self.scheduler.step()
         if self.checkpoint_dir and self.checkpoint_every and \
                 self.step_id % self.checkpoint_every == 0:
-            with timer("saveCheckpoint"):
-                _io.save_checkpoint(self.exe, self.checkpoint_dir,
-                                    self.step_id, self.main_program)
+            self._save_checkpoint(telemetry)
         if self.async_metrics:
             return dict(zip(names, vals))
         return dict(zip(names, [np.asarray(v).item()
                                 if np.asarray(v).size == 1 else
                                 np.asarray(v) for v in vals]))
+
+    def _save_checkpoint(self, telemetry):
+        ck0 = time.perf_counter()
+        with timer("saveCheckpoint"):
+            _io.save_checkpoint(self.exe, self.checkpoint_dir,
+                                self.step_id, self.main_program)
+        if telemetry:
+            _CKPT_SECONDS.observe(time.perf_counter() - ck0)
+
+    def _record_step(self, feed, t0, t1):
+        """Telemetry-path step accounting (flag already checked).
+
+        Throughput is computed over the cumulative window since this
+        Trainer's first step: under async_metrics the per-step wall
+        time is dispatch-only (no host sync — PROFILE.md), so an
+        instantaneous examples/dt would be wildly inflated; the
+        cumulative rate stays correct because the device eventually
+        backpressures the dispatching host."""
+        n = _batch_size(feed)
+        _STEP_SECONDS.observe(t1 - t0)
+        _STEPS_TOTAL.inc()
+        if self._tel_t0 is None:
+            self._tel_t0 = t0
+        eps = 0.0
+        if n:
+            _EXAMPLES_TOTAL.inc(n)
+            self._tel_examples += n
+            if t1 > self._tel_t0:
+                eps = self._tel_examples / (t1 - self._tel_t0)
+                _EXAMPLES_PER_SEC.labels(trainer=self._tel_label) \
+                    .set(eps)
+        interval = self.periodic_log_interval
+        if interval and (self.step_id + 1) % interval == 0:
+            _log.structured(
+                "train_throughput", step=self.step_id + 1,
+                step_ms=round((t1 - t0) * 1e3, 3),
+                examples_per_sec=round(eps, 2),
+                examples_total=int(_EXAMPLES_TOTAL.value),
+                steps_total=int(_STEPS_TOTAL.value))
 
     def train(self, reader, num_passes=1, event_handler=None,
               prefetch=8, staging=True):
@@ -146,6 +238,7 @@ class Trainer:
             if not staged.arena_active:
                 staged = None  # native arena unavailable
         batches = None
+        exc_live = False
         try:
             for pass_id in range(num_passes):
                 event_handler(BeginPass(pass_id))
@@ -160,20 +253,46 @@ class Trainer:
                 last_metrics = {}
                 for batch_id, batch in enumerate(batches):
                     event_handler(BeginIteration(pass_id, batch_id))
-                    metrics = run_one(batch)
+                    with _tracing.span("trainStep"):
+                        metrics = run_one(batch)
                     last_metrics = metrics
                     event_handler(EndIteration(pass_id, batch_id,
                                                self.step_id, metrics))
                 if self.checkpoint_dir:
-                    _io.save_checkpoint(self.exe, self.checkpoint_dir,
-                                        self.step_id, self.main_program)
+                    self._save_checkpoint(_config.get_flag("telemetry"))
                 event_handler(EndPass(pass_id, last_metrics))
+        except BaseException:
+            # flag for teardown: sys.exc_info() in the finally would
+            # also see an outer HANDLED exception and misreport
+            exc_live = True
+            raise
         finally:
             if staged is not None:
-                if batches is not None:
-                    batches.close()  # stop+join the fill thread first
-                stat_set.set_gauges(staged.stats())
-                staged.close()
+                self._teardown_staged(staged, batches, exc_live)
+
+    @staticmethod
+    def _teardown_staged(staged, batches, exc_live):
+        """Stop the staged reader and record its final gauges. When an
+        exception is already propagating out of the train loop
+        (``exc_live``), teardown errors are logged instead of raised so
+        they can't mask the original failure."""
+        def _guard(fn):
+            try:
+                return fn()
+            except Exception:
+                if not exc_live:
+                    raise
+                _log.logger().warning(
+                    "staged-reader teardown error (suppressed; an "
+                    "exception is already propagating)", exc_info=True)
+                return None
+
+        if batches is not None:
+            _guard(batches.close)  # stop+join the fill thread first
+        gauges = _guard(staged.stats)
+        if gauges:
+            _guard(lambda: stat_set.set_gauges(gauges))
+        _guard(staged.close)
 
     def test(self, reader, test_program, fetch_dict):
         """Average fetches over a test reader (Tester parity)."""

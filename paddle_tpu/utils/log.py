@@ -6,12 +6,32 @@ on Python's logging with env-controlled verbosity:
 * ``PADDLE_TPU_VLOG``     — integer VLOG verbosity (default 0)
 """
 
+import json
 import logging
 import os
+import sys
 
-__all__ = ["logger", "vlog", "set_level"]
+__all__ = ["logger", "telemetry_logger", "vlog", "set_level",
+           "structured"]
 
 _LOGGER = None
+
+
+class _StderrHandler(logging.StreamHandler):
+    """StreamHandler that resolves sys.stderr at EMIT time, so the
+    logger keeps working when the stream is swapped after setup (pytest
+    capture, daemon redirection)."""
+
+    def __init__(self):
+        logging.Handler.__init__(self)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+    @stream.setter
+    def stream(self, value):  # base-class ctor assigns; ignore
+        pass
 
 
 def logger():
@@ -19,7 +39,7 @@ def logger():
     if _LOGGER is None:
         lg = logging.getLogger("paddle_tpu")
         if not lg.handlers:
-            h = logging.StreamHandler()
+            h = _StderrHandler()
             h.setFormatter(logging.Formatter(
                 "%(levelname).1s %(asctime)s %(name)s] %(message)s",
                 "%m%d %H:%M:%S"))
@@ -39,3 +59,29 @@ def vlog(n, msg, *args):
     """VLOG(n): emitted at INFO when PADDLE_TPU_VLOG >= n."""
     if int(os.environ.get("PADDLE_TPU_VLOG", "0")) >= n:
         logger().info(msg, *args)
+
+
+def telemetry_logger():
+    """Child logger for machine-parseable telemetry lines. Level INFO
+    by default so explicitly-requested telemetry (e.g. Trainer's
+    ``periodic_log_interval``) emits without touching the package log
+    level (the parent's WARNING default filters its OWN records, not
+    propagated child records — only handler levels apply). Silence
+    with ``logging.getLogger("paddle_tpu.telemetry").setLevel(...)``.
+    """
+    lg = logging.getLogger("paddle_tpu.telemetry")
+    if lg.level == logging.NOTSET:
+        lg.setLevel(logging.INFO)
+    logger()  # ensure the parent handler exists to propagate into
+    return lg
+
+
+def structured(event, **fields):
+    """One machine-parseable line: ``<event> {json fields}``.
+
+    The telemetry log format (trainer periodic throughput lines etc.):
+    grep the event name, json-parse the rest.
+    """
+    telemetry_logger().info("%s %s", event,
+                            json.dumps(fields, sort_keys=True,
+                                       default=str))

@@ -76,6 +76,12 @@ def test_two_process_global_mesh_all_reduce():
         if not self_failed:
             pytest.skip("distributed workers timed out "
                         "(coordinator blocked in this env)")
+        if any("Multiprocess computations aren't implemented" in out
+               for _, out, _ in rows):
+            # this jaxlib's CPU backend cannot run cross-process
+            # computations at all — environment gap, not a code bug
+            pytest.skip("jaxlib CPU backend lacks multiprocess "
+                        "computation support")
     for pid, (p, out, timed) in enumerate(rows):
         assert p.returncode == 0, "worker %d %s:\n%s" % (
             pid, "timed out" if timed else "failed", out)

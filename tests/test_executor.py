@@ -3,6 +3,7 @@ threading, fetch, program isolation (reference test_executor /
 framework tests)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as ptpu
 from paddle_tpu import layers
@@ -91,6 +92,56 @@ def test_two_programs_share_scope_params():
     a, = exe.run(main, feed={"x": xv}, fetch_list=[h])
     b, = exe.run(test_prog, feed={"x": xv}, fetch_list=[h2])
     np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+@pytest.fixture
+def check_nan_inf():
+    ptpu.config.set_flags(check_nan_inf=True)
+    yield
+    ptpu.config.set_flags(check_nan_inf=False)
+
+
+def test_nan_guard_raises_with_offending_op_key(check_nan_inf):
+    """FLAGS_check_nan_inf parity (reference framework/executor.cc:
+    120-128): a non-finite op output fails the step with the
+    ``op#i:type:var`` key of the producer."""
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.log(x)       # log(-1) -> NaN
+        z = layers.scale(y, scale=2.0)
+    exe = ptpu.Executor()
+    with pytest.raises(FloatingPointError) as ei:
+        exe.run(main, feed={"x": -np.ones((2, 4), "float32")},
+                fetch_list=[z])
+    msg = str(ei.value)
+    assert "NaN/Inf detected" in msg
+    assert ":log:" in msg and "op#" in msg
+    assert y.name in msg
+    # downstream consumers of the NaN are flagged too (per-op scan)
+    assert ":scale:" in msg
+
+
+def test_nan_guard_passes_finite_program(check_nan_inf):
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.log(x)
+    exe = ptpu.Executor()
+    out, = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                   fetch_list=[y])
+    np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+
+def test_nan_guard_off_lets_nan_through():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.log(x)
+    exe = ptpu.Executor()
+    out, = exe.run(main, feed={"x": -np.ones((2, 4), "float32")},
+                   fetch_list=[y])
+    assert np.isnan(out).all()
 
 
 def test_uninitialized_param_raises():

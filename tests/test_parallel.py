@@ -260,7 +260,9 @@ class TestRingAttentionUnderMesh:
         qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
         out = parallel.ring_attention(qs, ks, vs, mesh,
                                       axis_name="data", causal=True)
-        assert out.sharding.spec == spec
+        # jax versions differ on whether trailing Nones are kept in the
+        # spec repr; compare sharding equivalence, not spec identity
+        assert out.sharding.is_equivalent_to(sh, out.ndim), out.sharding
         ref = parallel.dense_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-5)
