@@ -21,7 +21,10 @@ device-to-host sync at the end of the timed window (the train loop never
 blocks on a per-step fetch), state donation keeping updates in-place.
 """
 
+import glob
 import json
+import os
+import re
 import sys
 import time
 
@@ -35,6 +38,68 @@ _PEAK_FLOPS = {
     "TPU v5p": 459e12,
     "TPU v6 lite": 918e12,
 }
+
+
+# -- regression tripwire (VERDICT r5 demand 6) ---------------------------
+# Every metric here is higher-is-better (throughput / overlap
+# efficiency), so a drop beyond REGRESSION_TOLERANCE vs the most recent
+# recorded run flags regressed=true with drift context on that line.
+REGRESSION_TOLERANCE = 0.10
+
+
+def parse_bench_tail(text):
+    """Metric -> value from a BENCH_r*.json "tail" (one JSON obj per
+    line, non-JSON noise lines skipped)."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj and "value" in obj:
+            out[obj["metric"]] = obj["value"]
+    return out
+
+
+def load_previous_metrics(repo_dir=None):
+    """Metrics from the highest-numbered BENCH_r*.json next to this
+    file (empty dict when none exist or parsing fails)."""
+    repo = repo_dir or os.path.dirname(os.path.abspath(__file__))
+    best, best_n = None, -1
+    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m and int(m.group(1)) > best_n:
+            best, best_n = path, int(m.group(1))
+    if best is None:
+        return {}
+    try:
+        with open(best) as f:
+            doc = json.load(f)
+        return parse_bench_tail(doc.get("tail", ""))
+    except (OSError, ValueError):
+        return {}
+
+
+def annotate_regression(result, prev_metrics,
+                        rel_tol=REGRESSION_TOLERANCE):
+    """Add prev_value/drift/regressed to one bench result line.
+    ``drift`` is the relative change vs the previous run (+ = faster);
+    ``regressed`` trips when the metric fell more than ``rel_tol``."""
+    if not isinstance(result, dict) or "value" not in result:
+        return result
+    prev = prev_metrics.get(result.get("metric"))
+    if not prev:
+        result["prev_value"] = None
+        result["regressed"] = False
+        return result
+    drift = float(result["value"]) / float(prev) - 1.0
+    result["prev_value"] = prev
+    result["drift"] = round(drift, 3)
+    result["regressed"] = bool(drift < -rel_tol)
+    return result
 
 
 def _device_info():
@@ -373,6 +438,7 @@ def main():
     on_accel, peak = _device_info()
     if on_accel:
         ptpu.config.set_flags(amp="bfloat16", flash_attention=True)
+    prev_metrics = load_previous_metrics()
 
     # secondary metrics first and fenced: a failure in any must never
     # cost the headline resnet line (the driver parses the final line)
@@ -384,13 +450,16 @@ def main():
             ("resnet_pipeline_overlap",
              lambda: bench_resnet_pipeline(on_accel))]:
         try:
-            print(json.dumps(_isolated(fn)), flush=True)
+            print(json.dumps(annotate_regression(_isolated(fn),
+                                                 prev_metrics)),
+                  flush=True)
         except Exception as e:  # pragma: no cover
             msg = "%s: %s" % (type(e).__name__, e)
             print(json.dumps({"metric": name, "error": msg[:300]}),
                   flush=True)
-    print(json.dumps(_isolated(
-        lambda: bench_resnet(on_accel, peak))), flush=True)
+    print(json.dumps(annotate_regression(
+        _isolated(lambda: bench_resnet(on_accel, peak)),
+        prev_metrics)), flush=True)
 
 
 if __name__ == "__main__":

@@ -23,20 +23,31 @@ _lock = threading.Lock()
 _DTYPES = {0: np.float32, 1: np.int32, 2: np.int64}
 
 
-def load_model(dirname):
-    """Load an inference dir (JSON __model__ + params) -> int handle."""
+def load_model(dirname, batch_buckets=None):
+    """Load an inference dir (JSON __model__ + params) -> int handle.
+    With ``batch_buckets`` the handle serves through a bucketed
+    ServingEngine (padded shapes against the compile cache, AOT-warmed)
+    instead of a raw Executor — the C serving path then shares the
+    Python serving layer's shape discipline and metrics."""
     from . import io as _io
     from .core.executor import Executor
     from .core.scope import Scope, scope_guard
 
-    scope = Scope()
-    exe = Executor()
-    with scope_guard(scope):
-        program, feed_names, fetch_names = _io.load_inference_model(
-            dirname, exe, scope=scope)
-    entry = {"exe": exe, "scope": scope, "program": program,
-             "feed_names": feed_names, "fetch_names": fetch_names,
-             "lock": threading.Lock()}
+    if batch_buckets:
+        from .serving.engine import ServingEngine
+        eng = ServingEngine(dirname, buckets=batch_buckets)
+        entry = {"serving": eng, "feed_names": list(eng.feed_names),
+                 "fetch_names": list(eng.fetch_names),
+                 "lock": threading.Lock()}
+    else:
+        scope = Scope()
+        exe = Executor()
+        with scope_guard(scope):
+            program, feed_names, fetch_names = _io.load_inference_model(
+                dirname, exe, scope=scope)
+        entry = {"exe": exe, "scope": scope, "program": program,
+                 "feed_names": feed_names, "fetch_names": fetch_names,
+                 "lock": threading.Lock()}
     with _lock:
         handle = _next_id[0]
         _next_id[0] += 1
@@ -54,10 +65,13 @@ def forward(handle, inputs):
         arr = np.frombuffer(buf, dtype=dt).reshape(
             [int(s) for s in shape])
         feed[name] = arr
-    with entry["lock"]:
-        outs = entry["exe"].run(entry["program"], feed=feed,
-                                fetch_list=entry["fetch_names"],
-                                scope=entry["scope"])
+    if "serving" in entry:
+        outs = entry["serving"].run(feed)  # engine is itself thread-safe
+    else:
+        with entry["lock"]:
+            outs = entry["exe"].run(entry["program"], feed=feed,
+                                    fetch_list=entry["fetch_names"],
+                                    scope=entry["scope"])
     result = []
     for name, val in zip(entry["fetch_names"], outs):
         a = np.ascontiguousarray(np.asarray(val), dtype=np.float32)

@@ -24,6 +24,11 @@ amp: None or 'bfloat16'. Mixed-precision policy applied by the executor at
   moves ~140 GB HBM/step at batch 256 and is bandwidth-bound on a TPU
   v5e (~819 GB/s); bf16 activations halve that.
 
+serving_buckets: default batch buckets for serving.ServingEngine —
+  incoming request batches are zero-padded up to the nearest bucket so
+  the executor's compile cache sees a closed set of shapes (engines
+  constructed with explicit ``buckets=`` ignore this).
+
 telemetry: if True, arm the observability layer (observability/):
   executor compile-cache + cost-analysis metrics, trainer step-latency/
   throughput metrics, staging queue/arena gauges, and host trace spans
@@ -41,6 +46,7 @@ _flags = {
     # ops/pallas_attention.py); interpret-mode off-TPU
     "flash_attention": False,
     "telemetry": False,
+    "serving_buckets": (1, 8, 32),
 }
 
 # Observers called with the flag dict after every set_flags (the

@@ -4,13 +4,19 @@ Parity with reference ``paddle/inference`` (InferenceEngine::
 LoadInferenceModel + Execute, ``inference.h:23-45``) and v2
 ``paddle.v2.inference.Inference.infer``. Loads an exported model dir and
 runs the pruned program as one jitted XLA computation.
+
+For production traffic (micro-batching, bucketed shapes, int8 exports,
+device replicas) use :mod:`paddle_tpu.serving` — this module is the
+simple load-and-run surface.
 """
 
-import numpy as np
+import collections
+import os
+import threading
 
 from . import io as _io
 from .core.executor import Executor
-from .core.scope import Scope, scope_guard
+from .core.scope import Scope
 
 __all__ = ["InferenceEngine", "infer"]
 
@@ -19,22 +25,59 @@ class InferenceEngine:
     def __init__(self, model_dir, place=None):
         self.exe = Executor(place=place)
         self.scope = Scope()
-        with scope_guard(self.scope):
-            (self.program, self.feed_names,
-             self.fetch_names) = _io.load_inference_model(model_dir,
-                                                          self.exe)
+        (self.program, self.feed_names,
+         self.fetch_names) = _io.load_inference_model(model_dir, self.exe,
+                                                      scope=self.scope)
 
     def run(self, feed):
-        """feed: {name: array} (or positional list matching feed_names)."""
+        """feed: {name: array} (or positional list matching feed_names).
+        The engine's scope is passed explicitly (no global scope_guard
+        swap), so concurrent runs of different cached engines can't
+        read each other's state."""
         if isinstance(feed, (list, tuple)):
             feed = dict(zip(self.feed_names, feed))
-        with scope_guard(self.scope):
-            return self.exe.run(self.program, feed=feed,
-                                fetch_list=self.fetch_names)
+        return self.exe.run(self.program, feed=feed,
+                            fetch_list=self.fetch_names,
+                            scope=self.scope)
 
 
-def infer(model_dir, feed, place=None):
-    """One-shot helper (v2 paddle.infer parity)."""
-    engine = InferenceEngine(model_dir, place=place)
+# Keyed engine cache for the one-shot helper: repeated infer() calls on
+# the same (unmodified) export reuse the loaded params AND the compiled
+# program instead of paying a full model load + retrace per call. Keys
+# include the __model__ file's mtime/size so a re-export invalidates.
+_ENGINE_CACHE = collections.OrderedDict()
+_ENGINE_CACHE_MAX = 8
+_ENGINE_CACHE_LOCK = threading.Lock()
+
+
+def _engine_cache_key(model_dir, place):
+    path = model_dir if os.path.isfile(model_dir) \
+        else os.path.join(model_dir, "__model__")
+    st = os.stat(path)
+    return (os.path.abspath(model_dir), str(place), st.st_mtime_ns,
+            st.st_size)
+
+
+def clear_engine_cache():
+    with _ENGINE_CACHE_LOCK:
+        _ENGINE_CACHE.clear()
+
+
+def infer(model_dir, feed, place=None, use_cache=True):
+    """One-shot helper (v2 paddle.infer parity); engine-cached."""
+    if use_cache:
+        key = _engine_cache_key(model_dir, place)
+        with _ENGINE_CACHE_LOCK:
+            engine = _ENGINE_CACHE.get(key)
+            if engine is not None:
+                _ENGINE_CACHE.move_to_end(key)
+        if engine is None:
+            engine = InferenceEngine(model_dir, place=place)
+            with _ENGINE_CACHE_LOCK:
+                _ENGINE_CACHE[key] = engine
+                while len(_ENGINE_CACHE) > _ENGINE_CACHE_MAX:
+                    _ENGINE_CACHE.popitem(last=False)
+    else:
+        engine = InferenceEngine(model_dir, place=place)
     outs = engine.run(feed)
     return outs[0] if len(outs) == 1 else outs

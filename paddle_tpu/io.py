@@ -166,9 +166,12 @@ def prune_program(program, fetch_names):
 
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
-                         main_program=None, scope=None):
+                         main_program=None, scope=None, quantize=None):
     """Export pruned program + params for inference (reference
-    save_inference_model:223 — prunes to feed/fetch targets)."""
+    save_inference_model:223 — prunes to feed/fetch targets).
+    ``quantize="int8"`` additionally rewrites the exported weights to
+    per-output-channel int8 (serving/quant.py); load_inference_model
+    dequantizes transparently."""
     from .core.framework import default_main_program
     program = main_program or default_main_program()
     program = prune_program(program, [v.name for v in target_vars])
@@ -181,6 +184,9 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     from .core.serialization import program_to_dict
     with open(os.path.join(dirname, "__model__"), "w") as f:
         json.dump({"program": program_to_dict(program), "spec": spec}, f)
+    if quantize:
+        from .serving import quant as _quant
+        _quant.quantize_model_dir(dirname, program=program, dtype=quantize)
 
 
 def load_inference_model(dirname, executor, scope=None):
@@ -200,6 +206,12 @@ def load_inference_model(dirname, executor, scope=None):
         program = program_from_dict(bundle["program"])
         load_params(executor, dirname, main_program=program,
                     scope=scope)
+        # int8-exported weights (quant.json sidecar) dequantize here, so
+        # every loader (engines, C API, merged files) is quant-agnostic
+        from .serving import quant as _quant
+        _quant.maybe_dequantize(dirname,
+                                scope if scope is not None
+                                else global_scope())
     finally:
         if tmp_dir is not None:
             # params land in the scope during load; the unpacked dir

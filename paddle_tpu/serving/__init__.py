@@ -1,0 +1,28 @@
+"""Inference serving subsystem (the reference's ``paddle/capi``
+examples tier, rebuilt TPU-native — see ROADMAP north star).
+
+Three cooperating pieces:
+
+* :mod:`engine`  — :class:`ServingEngine`: loads an exported/merged
+  model once, pads requests to fixed batch buckets (the Executor's
+  compile cache then sees a closed shape set), AOT-warms every bucket,
+  and dispatches round-robin across device replicas.
+* :mod:`batcher` — :class:`MicroBatcher`: thread-safe
+  ``submit(feed) -> Future`` micro-batching with a max-latency
+  deadline and bounded-queue backpressure.
+* :mod:`quant`   — post-training int8 weight quantization
+  (per-output-channel symmetric scales) wired into
+  ``io.save_inference_model(..., quantize="int8")`` and transparently
+  dequantized at load.
+
+Everything is instrumented through :mod:`paddle_tpu.observability`;
+``tools/serving_probe.py`` exercises the stack headless and prints the
+Prometheus exposition.
+"""
+
+from . import quant  # noqa: F401
+from .engine import ServingEngine  # noqa: F401
+from .batcher import MicroBatcher, ServingOverloadError  # noqa: F401
+
+__all__ = ["ServingEngine", "MicroBatcher", "ServingOverloadError",
+           "quant"]

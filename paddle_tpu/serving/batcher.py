@@ -1,0 +1,198 @@
+"""Micro-batching front door: ``submit(feed) -> Future``.
+
+Concurrent single-example requests (the serving traffic shape — many
+users, one example each) coalesce into bucket-sized batches before
+hitting the device: the dispatcher thread takes the first queued
+request, then keeps gathering until the batch fills or a max-latency
+deadline expires, stacks the examples batch-major, and runs them
+through the :class:`~paddle_tpu.serving.engine.ServingEngine` as ONE
+padded-bucket execution. Each caller's Future resolves to its own row
+of the outputs, so the batching is invisible to clients.
+
+Backpressure is a bounded queue: ``submit`` blocks while the queue is
+full (or raises :class:`ServingOverloadError` when a ``timeout`` is
+given) instead of letting an unbounded backlog grow.
+
+Metrics: ``paddle_serving_request_seconds`` (submit -> result latency
+histogram) and ``paddle_serving_queue_depth`` (gauge). Mean batch
+occupancy is derivable from the engine's ``requests_total`` /
+``batches_total`` counters.
+"""
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
+
+__all__ = ["MicroBatcher", "ServingOverloadError"]
+
+_REQUEST_SECONDS = _metrics.REGISTRY.histogram(
+    "paddle_serving_request_seconds",
+    "Per-request latency, submit() to Future resolution")
+_QUEUE_DEPTH = _metrics.REGISTRY.gauge(
+    "paddle_serving_queue_depth",
+    "Requests waiting in the micro-batcher queue")
+
+
+class ServingOverloadError(RuntimeError):
+    """The bounded request queue stayed full past the submit timeout."""
+
+
+class _WorkItem:
+    __slots__ = ("feed", "future", "t_submit")
+
+    def __init__(self, feed):
+        self.feed = feed
+        self.future = Future()
+        self.t_submit = time.perf_counter()
+
+
+_STOP = object()
+
+
+def _resolve(future, result=None, exception=None):
+    """Set a Future's outcome without letting a client-side cancel()
+    (racing the cancelled() check) raise InvalidStateError and kill the
+    dispatcher thread."""
+    try:
+        if not future.cancelled():
+            if exception is not None:
+                future.set_exception(exception)
+            else:
+                future.set_result(result)
+    except Exception:
+        pass  # already cancelled/resolved: the client walked away
+
+
+class MicroBatcher:
+    """Coalesces single-example submissions into engine batches.
+
+    ``submit`` takes one example per feed name WITHOUT the batch dim
+    (it is stacked on axis 0 here); the Future resolves to the list of
+    per-example fetch outputs. ``max_batch`` defaults to the engine's
+    largest bucket; ``max_delay_ms`` bounds the extra latency a lone
+    request pays waiting for company.
+    """
+
+    def __init__(self, engine, max_batch=None, max_delay_ms=5.0,
+                 max_queue=256, autostart=True):
+        self.engine = engine
+        self.max_batch = int(max_batch or engine.max_bucket)
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.max_delay = float(max_delay_ms) / 1e3
+        self._q = queue.Queue(maxsize=max_queue)
+        self._thread = None
+        self._closed = False
+        if autostart:
+            self.start()
+
+    def start(self):
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="micro-batcher",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def submit(self, feed, timeout=None):
+        """Enqueue one example; returns a Future of its outputs. Blocks
+        while the queue is full; with ``timeout`` (seconds) raises
+        :class:`ServingOverloadError` instead."""
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        if isinstance(feed, (list, tuple)):
+            feed = dict(zip(self.engine.feed_names, feed))
+        item = _WorkItem({n: np.asarray(feed[n])
+                          for n in self.engine.feed_names})
+        try:
+            self._q.put(item, block=True, timeout=timeout)
+        except queue.Full:
+            raise ServingOverloadError(
+                "serving queue full (%d pending)" % self._q.qsize()) \
+                from None
+        _QUEUE_DEPTH.set(self._q.qsize())
+        return item.future
+
+    # -- dispatcher ------------------------------------------------------
+    def _loop(self):
+        while True:
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._closed:
+                    return
+                continue
+            if first is _STOP:
+                return
+            batch = [first]
+            deadline = time.perf_counter() + self.max_delay
+            stop = False
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    stop = True
+                    break
+                batch.append(nxt)
+            _QUEUE_DEPTH.set(self._q.qsize())
+            self._flush(batch)
+            if stop:
+                return
+
+    def _flush(self, batch):
+        try:
+            with _tracing.span("servingBatch", size=len(batch)):
+                feed = {name: np.stack([it.feed[name] for it in batch])
+                        for name in self.engine.feed_names}
+                outs = self.engine.run(feed)
+        except Exception as exc:  # mismatched shapes, engine failure, ...
+            for it in batch:
+                _resolve(it.future, exception=exc)
+            return
+        now = time.perf_counter()
+        for i, it in enumerate(batch):
+            res = [o[i] if getattr(o, "ndim", 0) > 0 and
+                   o.shape[0] == len(batch) else o for o in outs]
+            _resolve(it.future, result=res)
+            _REQUEST_SECONDS.observe(now - it.t_submit)
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self, timeout=5.0):
+        """Drain-and-stop: queued requests before the stop marker still
+        complete; subsequent submits raise."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None:
+            self._q.put(_STOP)
+            self._thread.join(timeout)
+            self._thread = None
+        # A submit() racing close() can land behind the stop marker;
+        # fail those futures rather than leave result() hanging forever.
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                _resolve(item.future,
+                         exception=RuntimeError("batcher closed"))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,224 @@
+"""ServingEngine: bucketed, replicated inference front end.
+
+The reference served models through ``paddle/capi`` one request at a
+time (``capi/examples/model_inference/``); on TPU the dominant costs are
+different — XLA recompiles per input *shape* and a single request
+under-fills the MXU — so the serving engine is built around three
+JAX/XLA idioms:
+
+* **batch buckets**: every incoming batch is zero-padded up to a fixed
+  bucket size, so the Executor's flag-keyed compile cache sees a small
+  closed set of shapes and steady-state traffic never recompiles.
+* **AOT warmup**: each bucket is compiled once at startup (per replica)
+  so the first user request doesn't pay multi-second XLA compile
+  latency.
+* **device replicas**: model state is ``device_put`` onto N devices;
+  requests dispatch round-robin, each replica serializing its own runs
+  behind a lock (the jitted computation itself is thread-safe, the
+  lock keeps per-replica HBM traffic ordered).
+
+Quantized artifacts (``io.save_inference_model(..., quantize="int8")``)
+load transparently — dequantization happens in ``load_inference_model``
+— so the same engine serves f32 and int8 exports.
+
+Metrics (always on — the front door is not a per-op hot path):
+``paddle_serving_requests_total``, ``paddle_serving_batches_total``
+{bucket}, ``paddle_serving_batch_occupancy``,
+``paddle_serving_batch_seconds``{bucket},
+``paddle_serving_bucket_compiles_total``{bucket},
+``paddle_serving_bucket_overflow_total``. Host spans (``servingRun``)
+flow to the Chrome trace when the ``telemetry`` flag is armed.
+"""
+
+import itertools
+import threading
+import time
+
+import numpy as np
+
+import jax
+
+from .. import config as _config
+from .. import io as _io
+from ..core.executor import Executor
+from ..core.scope import Scope
+from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
+
+__all__ = ["ServingEngine"]
+
+_REQUESTS = _metrics.REGISTRY.counter(
+    "paddle_serving_requests_total",
+    "Examples served through ServingEngine.run")
+_BATCHES = _metrics.REGISTRY.counter(
+    "paddle_serving_batches_total",
+    "Batches executed per bucket size", labelnames=("bucket",))
+_OCCUPANCY = _metrics.REGISTRY.gauge(
+    "paddle_serving_batch_occupancy",
+    "Real examples / bucket size of the most recent batch")
+_BATCH_SECONDS = _metrics.REGISTRY.histogram(
+    "paddle_serving_batch_seconds",
+    "Device execute wall time per batch", labelnames=("bucket",))
+_BUCKET_COMPILES = _metrics.REGISTRY.counter(
+    "paddle_serving_bucket_compiles_total",
+    "First-time (compile) executions per bucket per replica",
+    labelnames=("bucket",))
+_OVERFLOWS = _metrics.REGISTRY.counter(
+    "paddle_serving_bucket_overflow_total",
+    "Requests larger than the biggest bucket (served unpadded)")
+
+
+class _Replica:
+    __slots__ = ("exe", "scope", "device", "lock", "seen")
+
+    def __init__(self, exe, scope, device):
+        self.exe = exe
+        self.scope = scope
+        self.device = device
+        self.lock = threading.Lock()
+        self.seen = set()  # feed signatures already compiled here
+
+
+class ServingEngine:
+    """Loads an exported model once and serves padded-bucket batches.
+
+    ``model_dir`` may be a ``save_inference_model`` dir or a merged
+    single-file model. ``buckets`` defaults to the ``serving_buckets``
+    config flag. ``replicas`` > 1 copies the weights onto that many
+    devices (round-robin over ``jax.devices()``) and fans requests out.
+    """
+
+    def __init__(self, model_dir, buckets=None, replicas=1, devices=None,
+                 warmup=True, place=None):
+        if buckets is None:
+            buckets = _config.get_flag("serving_buckets")
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError("buckets must be positive ints, got %r"
+                             % (buckets,))
+
+        exe0 = Executor(place=place)
+        scope0 = Scope()
+        (self.program, self.feed_names,
+         self.fetch_names) = _io.load_inference_model(
+             model_dir, exe0, scope=scope0)
+        block = self.program.global_block()
+        self._feed_specs = {}
+        for name in self.feed_names:
+            var = block.var_or_none(name)
+            if var is not None:
+                self._feed_specs[name] = (tuple(var.shape or ()),
+                                          np.dtype(var.dtype))
+
+        if devices is None and replicas > 1:
+            devs = jax.devices()
+            devices = [devs[i % len(devs)] for i in range(replicas)]
+        self.replicas = []
+        if not devices:
+            self.replicas.append(_Replica(exe0, scope0, None))
+        else:
+            host = {n: np.asarray(v) for n, v in scope0.items()}
+            for i, dev in enumerate(devices):
+                scope = Scope()
+                for n, v in host.items():
+                    scope.set_var(n, jax.device_put(v, dev))
+                exe = exe0 if i == 0 else Executor(place=place)
+                self.replicas.append(_Replica(exe, scope, dev))
+        self._rr = itertools.count()
+        if warmup:
+            self.warmup()
+
+    @property
+    def max_bucket(self):
+        return self.buckets[-1]
+
+    # -- execution -------------------------------------------------------
+    def _bucket_for(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return None
+
+    def _execute(self, rep, feed, bucket):
+        sig = tuple(sorted((n, a.shape) for n, a in feed.items()))
+        if sig not in rep.seen:
+            rep.seen.add(sig)
+            _BUCKET_COMPILES.labels(bucket=bucket).inc()
+        if rep.device is not None:
+            feed = {n: jax.device_put(a, rep.device)
+                    for n, a in feed.items()}
+        with rep.lock, _tracing.span("servingRun", bucket=bucket):
+            return rep.exe.run(self.program, feed=feed,
+                               fetch_list=self.fetch_names,
+                               scope=rep.scope)
+
+    def run(self, feed):
+        """Serve one batch: pads to the nearest bucket, dispatches to the
+        next replica, slices outputs back to the real batch size.
+        ``feed``: {name: array} or positional list; arrays are
+        batch-major. Thread-safe."""
+        if isinstance(feed, (list, tuple)):
+            feed = dict(zip(self.feed_names, feed))
+        arrays = {}
+        n = None
+        for name in self.feed_names:
+            if name not in feed:
+                raise KeyError("missing feed %r (expects %s)"
+                               % (name, self.feed_names))
+            a = np.asarray(feed[name])
+            if a.ndim == 0:
+                raise ValueError("feed %r must be batch-major" % name)
+            if n is None:
+                n = a.shape[0]
+            elif a.shape[0] != n:
+                raise ValueError(
+                    "inconsistent batch: %r has %d rows, expected %d"
+                    % (name, a.shape[0], n))
+            arrays[name] = a
+        bucket = self._bucket_for(n)
+        if bucket is None:
+            bucket = n
+            _OVERFLOWS.inc()
+        elif bucket > n:
+            arrays = {name: np.concatenate(
+                [a, np.zeros((bucket - n,) + a.shape[1:], a.dtype)])
+                for name, a in arrays.items()}
+
+        rep = self.replicas[next(self._rr) % len(self.replicas)]
+        t0 = time.perf_counter()
+        outs = self._execute(rep, arrays, bucket)
+        _BATCH_SECONDS.labels(bucket=bucket).observe(
+            time.perf_counter() - t0)
+        _REQUESTS.inc(n)
+        _BATCHES.labels(bucket=bucket).inc()
+        _OCCUPANCY.set(n / float(bucket))
+        return [np.asarray(o)[:n]
+                if getattr(o, "ndim", 0) > 0 and o.shape[0] == bucket
+                else np.asarray(o) for o in outs]
+
+    # -- startup ---------------------------------------------------------
+    def warmup(self, example_feed=None):
+        """Compile every bucket on every replica ahead of traffic.
+        Feature dims come from the program's feed vars; a model with
+        dynamic (non-batch) dims needs ``example_feed`` — one example
+        per feed name, WITHOUT the batch dim. Returns the warmed
+        buckets."""
+        warmed = []
+        for b in self.buckets:
+            feed = {}
+            for name in self.feed_names:
+                if example_feed is not None and name in example_feed:
+                    ex = np.asarray(example_feed[name])
+                    feed[name] = np.zeros((b,) + ex.shape, ex.dtype)
+                    continue
+                spec = self._feed_specs.get(name)
+                if spec is None or any(d < 0 for d in spec[0][1:]):
+                    feed = None  # dynamic feature dim, can't synthesize
+                    break
+                feed[name] = np.zeros((b,) + tuple(spec[0][1:]), spec[1])
+            if feed is None:
+                continue
+            for rep in self.replicas:
+                self._execute(rep, feed, b)
+            warmed.append(b)
+        return warmed

@@ -1,0 +1,181 @@
+"""Post-training int8 weight quantization for exported inference models.
+
+The measured lever (PROFILE.md round 5): int8 matmul runs 1.71x bf16
+throughput on a v5e MXU and halves weight bytes — the right win for
+*serving*, where weights are frozen and per-channel scales recover
+almost all f32 accuracy. This pass is the serving-side wiring of that
+probe: ``io.save_inference_model(..., quantize="int8")`` rewrites the
+exported ``params.npz`` so matmul/conv weights are stored as int8 plus
+per-output-channel symmetric scales (a ``quant.json`` sidecar), and
+``io.load_inference_model`` transparently dequantizes at load time, so
+every consumer (InferenceEngine, ServingEngine, the C API bridge, a
+merged single-file model) reads a quantized artifact with no code
+changes. Running the *matmul itself* in int8 on-chip is the next step
+(PROFILE.md keeps the chip-measured line as a TODO); the artifact
+format already carries everything that needs (int8 weights + scales).
+
+Scope of the pass — weight-only, conservative:
+
+* only float32 ``Parameter`` tensors consumed exclusively through the
+  weight slot of a quantizable op (``mul``/``matmul`` rhs, ``conv2d``
+  filter) are quantized; biases, BN/LN scales, embeddings stay f32.
+* per-OUTPUT-channel symmetric scales (``scale_c = max|w_c| / 127``):
+  axis 1 for ``[in, out]`` matmul weights, axis 0 for
+  ``[out, in, kh, kw]`` conv filters.
+* a fallback list of numerically sensitive ops (softmax, layer_norm,
+  batch_norm, losses) — any parameter a fallback op touches is left in
+  high precision, mirroring the mixed-precision black list.
+"""
+
+import json
+import os
+
+import numpy as np
+
+__all__ = ["quantize_array", "dequantize_array", "select_quant_vars",
+           "quantize_model_dir", "load_quant_meta", "maybe_dequantize",
+           "QUANT_OPS", "DEFAULT_FALLBACK_OPS", "QUANT_META_FILE"]
+
+QUANT_META_FILE = "quant.json"
+
+# op type -> (weight input slot, per-output-channel axis of that weight)
+QUANT_OPS = {
+    "mul": ("Y", -1),
+    "matmul": ("Y", -1),
+    "conv2d": ("Filter", 0),
+}
+
+# Parameters consumed by any of these stay high precision (the serving
+# analog of the executor's AMP_BLACK list: sensitive reductions and
+# normalizers whose tiny affine params are not worth 8 bits).
+DEFAULT_FALLBACK_OPS = frozenset({
+    "softmax", "layer_norm", "batch_norm", "lookup_table",
+    "cross_entropy", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+})
+
+
+def quantize_array(w, axis):
+    """Symmetric per-channel int8: returns ``(q int8, scales f32)`` with
+    ``scales.shape == (w.shape[axis],)`` and ``w ~= q * scales`` along
+    ``axis`` (max abs error <= scale/2 per element)."""
+    w = np.asarray(w, dtype=np.float32)
+    axis = axis % w.ndim
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    amax = np.max(np.abs(w), axis=reduce_axes) if reduce_axes \
+        else np.abs(w)
+    scales = (amax / 127.0).astype(np.float32)
+    scales = np.where(scales == 0.0, np.float32(1.0), scales)
+    shape = [1] * w.ndim
+    shape[axis] = w.shape[axis]
+    q = np.clip(np.rint(w / scales.reshape(shape)), -127, 127) \
+        .astype(np.int8)
+    return q, scales
+
+
+def dequantize_array(q, scales, axis):
+    """Inverse of :func:`quantize_array` (up to rounding): f32 array."""
+    q = np.asarray(q)
+    scales = np.asarray(scales, dtype=np.float32)
+    axis = axis % q.ndim
+    shape = [1] * q.ndim
+    shape[axis] = q.shape[axis]
+    return q.astype(np.float32) * scales.reshape(shape)
+
+
+def select_quant_vars(program, fallback_ops=DEFAULT_FALLBACK_OPS):
+    """Map parameter name -> per-output-channel axis for every parameter
+    of ``program`` that is safe to quantize (see module docstring)."""
+    from ..core.framework import Parameter
+
+    block = program.global_block()
+    consumers = {}  # param name -> [(op_type, slot)]
+    for op in block.ops:
+        for slot, names in op.inputs.items():
+            for n in names:
+                v = block.var_or_none(n)
+                if isinstance(v, Parameter):
+                    consumers.setdefault(n, []).append((op.type, slot))
+
+    out = {}
+    for name, uses in consumers.items():
+        var = block.var(name)
+        if str(np.dtype(var.dtype)) != "float32" or var.shape is None \
+                or len(var.shape) < 2:
+            continue
+        if any(op_type in fallback_ops for op_type, _ in uses):
+            continue
+        axes = set()
+        ok = True
+        for op_type, slot in uses:
+            spec = QUANT_OPS.get(op_type)
+            if spec is None or spec[0] != slot:
+                ok = False
+                break
+            axes.add(spec[1] % len(var.shape))
+        if ok and len(axes) == 1:
+            out[name] = axes.pop()
+    return out
+
+
+def quantize_model_dir(dirname, program=None,
+                       fallback_ops=DEFAULT_FALLBACK_OPS, dtype="int8"):
+    """Rewrite an exported inference-model dir in place: quantizable
+    params in ``params.npz`` become int8 and ``quant.json`` records the
+    per-var scales. Returns the list of quantized var names."""
+    if dtype not in ("int8", True):
+        raise ValueError("unsupported quantize mode %r (only 'int8')"
+                         % (dtype,))
+    if program is None:
+        from ..core.serialization import program_from_dict
+        with open(os.path.join(dirname, "__model__")) as f:
+            program = program_from_dict(json.load(f)["program"])
+    targets = select_quant_vars(program, fallback_ops=fallback_ops)
+
+    npz_path = os.path.join(dirname, "params.npz")
+    meta_path = os.path.join(dirname, "params.meta.json")
+    with np.load(npz_path) as data:
+        arrays = {k: data[k] for k in data.files}
+    with open(meta_path) as f:
+        meta = json.load(f)
+
+    quantized = {}
+    for key, name in meta.items():
+        axis = targets.get(name)
+        if axis is None or key not in arrays:
+            continue
+        q, scales = quantize_array(arrays[key], axis)
+        arrays[key] = q
+        quantized[name] = {"axis": int(axis),
+                           "scales": [float(s) for s in scales]}
+    np.savez(npz_path[:-len(".npz")], **arrays)
+    with open(os.path.join(dirname, QUANT_META_FILE), "w") as f:
+        json.dump({"version": 1, "dtype": "int8", "vars": quantized}, f)
+    return sorted(quantized)
+
+
+def load_quant_meta(dirname):
+    """The dir's quant.json dict, or None when not quantized."""
+    path = os.path.join(dirname, QUANT_META_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def maybe_dequantize(dirname, scope):
+    """If ``dirname`` carries quant.json, replace each quantized var in
+    ``scope`` with its dequantized f32 array (transparent load path).
+    Returns the list of dequantized names."""
+    meta = load_quant_meta(dirname)
+    if meta is None:
+        return []
+    done = []
+    for name, info in meta["vars"].items():
+        q = scope.find_var(name)
+        if q is None:
+            continue
+        scope.set_var(name, dequantize_array(
+            np.asarray(q), info["scales"], info["axis"]))
+        done.append(name)
+    return done

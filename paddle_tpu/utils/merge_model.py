@@ -2,8 +2,9 @@
 ``python/paddle/utils/merge_model.py``: merge config + params into one
 file so the C API / mobile deployments ship a single artifact).
 
-A merged model is a plain zip of the inference dir's three members
-(``__model__`` JSON, ``params.npz``, ``params.meta.json``) — data-only,
+A merged model is a plain zip of the inference dir's members
+(``__model__`` JSON, ``params.npz``, ``params.meta.json``, plus
+``quant.json`` for int8 exports) — data-only,
 safe to load from untrusted sources (no pickle), and loadable by both
 ``io.load_inference_model`` and the C API's ``ptc_model_load``.
 """
@@ -15,6 +16,8 @@ import zipfile
 __all__ = ["merge_inference_model", "unpack_merged_model"]
 
 _MEMBERS = ("__model__", "params.npz", "params.meta.json")
+# present only in int8-quantized exports (serving/quant.py)
+_OPTIONAL_MEMBERS = ("quant.json",)
 
 
 def merge_inference_model(dirname, out_file):
@@ -29,6 +32,9 @@ def merge_inference_model(dirname, out_file):
     with zipfile.ZipFile(out_file, "w", zipfile.ZIP_DEFLATED) as z:
         for m in _MEMBERS:
             z.write(os.path.join(dirname, m), m)
+        for m in _OPTIONAL_MEMBERS:
+            if os.path.exists(os.path.join(dirname, m)):
+                z.write(os.path.join(dirname, m), m)
     return out_file
 
 
@@ -44,4 +50,7 @@ def unpack_merged_model(path):
                              % (path, missing))
         for m in _MEMBERS:
             z.extract(m, out)
+        for m in _OPTIONAL_MEMBERS:
+            if m in names:
+                z.extract(m, out)
     return out

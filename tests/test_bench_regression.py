@@ -1,0 +1,80 @@
+"""bench.py regression tripwire (VERDICT r5 demand 6): comparison
+logic against the most recent recorded BENCH_r*.json."""
+
+import json
+
+import bench
+
+
+class TestParseBenchTail:
+    def test_extracts_metric_lines_skips_noise(self):
+        tail = "\n".join([
+            "WARNING: some platform noise",
+            json.dumps({"metric": "a", "value": 10.0, "unit": "x/s"}),
+            "{not json at all",
+            json.dumps({"no_metric": True}),
+            json.dumps({"metric": "b", "value": 2.5}),
+        ])
+        assert bench.parse_bench_tail(tail) == {"a": 10.0, "b": 2.5}
+
+
+class TestLoadPreviousMetrics:
+    def test_picks_highest_round(self, tmp_path):
+        for n, val in [(3, 100.0), (12, 250.0)]:
+            (tmp_path / ("BENCH_r%02d.json" % n)).write_text(json.dumps({
+                "n": n,
+                "tail": json.dumps({"metric": "m", "value": val}) + "\n",
+            }))
+        assert bench.load_previous_metrics(str(tmp_path)) == {"m": 250.0}
+
+    def test_empty_when_absent_or_corrupt(self, tmp_path):
+        assert bench.load_previous_metrics(str(tmp_path)) == {}
+        (tmp_path / "BENCH_r01.json").write_text("{broken")
+        assert bench.load_previous_metrics(str(tmp_path)) == {}
+
+
+class TestAnnotateRegression:
+    def test_flags_drop_beyond_tolerance(self):
+        r = bench.annotate_regression(
+            {"metric": "m", "value": 80.0}, {"m": 100.0})
+        assert r["regressed"] is True
+        assert r["prev_value"] == 100.0
+        assert r["drift"] == -0.2
+
+    def test_small_drop_within_tolerance_passes(self):
+        r = bench.annotate_regression(
+            {"metric": "m", "value": 95.0}, {"m": 100.0})
+        assert r["regressed"] is False and r["drift"] == -0.05
+
+    def test_improvement_passes(self):
+        r = bench.annotate_regression(
+            {"metric": "m", "value": 130.0}, {"m": 100.0})
+        assert r["regressed"] is False and r["drift"] == 0.3
+
+    def test_no_prior_value_is_not_a_regression(self):
+        r = bench.annotate_regression(
+            {"metric": "new_metric", "value": 5.0}, {"m": 100.0})
+        assert r["regressed"] is False and r["prev_value"] is None
+        assert "drift" not in r
+
+    def test_error_lines_pass_through(self):
+        r = bench.annotate_regression({"metric": "m", "error": "boom"},
+                                      {"m": 100.0})
+        assert "regressed" not in r
+
+    def test_custom_tolerance(self):
+        r = bench.annotate_regression(
+            {"metric": "m", "value": 95.0}, {"m": 100.0}, rel_tol=0.02)
+        assert r["regressed"] is True
+
+    def test_round_trip_against_real_format(self):
+        """The annotator reads the exact shape bench.main writes into
+        the driver's BENCH_r*.json capture."""
+        tail = json.dumps({"metric": "resnet50_train_images_per_sec",
+                           "value": 2616.91, "unit": "images/sec",
+                           "vs_baseline": 31.124})
+        prev = bench.parse_bench_tail(tail)
+        r = bench.annotate_regression(
+            {"metric": "resnet50_train_images_per_sec",
+             "value": 2000.0}, prev)
+        assert r["regressed"] is True and r["prev_value"] == 2616.91

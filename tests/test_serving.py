@@ -1,0 +1,317 @@
+"""Serving subsystem: ServingEngine buckets/warmup/replicas, the
+micro-batcher under concurrency, the engine-cached one-shot infer(),
+the bucketed C API path, and the export->quantize->serve end-to-end
+acceptance path (ISSUE 2)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers, io
+from paddle_tpu.observability import metrics
+from paddle_tpu.serving import (MicroBatcher, ServingEngine,
+                                ServingOverloadError)
+
+pytestmark = pytest.mark.serving
+
+
+def _export(tmp_path, quantize=None, name="model", in_dim=16, out_dim=10):
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[in_dim])
+            h = layers.fc(x, 32, act="relu")
+            out = layers.fc(h, out_dim, act="softmax")
+        exe = ptpu.Executor()
+        exe.run(startup)
+        d = str(tmp_path / name)
+        io.save_inference_model(d, ["x"], [out], exe, main_program=main,
+                                quantize=quantize)
+        feed = np.random.RandomState(0).randn(24, in_dim) \
+            .astype("float32")
+        want, = exe.run(main, feed={"x": feed}, fetch_list=[out])
+    return d, feed, np.asarray(want)
+
+
+def _counter(name, **labels):
+    fam = metrics.REGISTRY.counter(name) if not labels else None
+    if fam is not None:
+        return fam.value
+    return metrics.REGISTRY._families[name].labels(**labels).value
+
+
+class TestServingEngine:
+    def test_bucket_padding_matches_unbatched(self, tmp_path):
+        d, feed, want = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4, 8), warmup=False)
+        for n in (1, 3, 4, 7):
+            got, = eng.run({"x": feed[:n]})
+            assert got.shape == (n, 10)
+            np.testing.assert_allclose(got, want[:n], rtol=1e-5,
+                                       atol=1e-6)
+
+    def test_buckets_bound_the_compile_cache(self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4, 8), warmup=True)
+        exe = eng.replicas[0].exe
+        warm_keys = len(exe._cache)
+        assert warm_keys == 2  # one compiled program per bucket
+        for n in (1, 2, 3, 4, 5, 8):  # all traffic lands in the buckets
+            eng.run({"x": feed[:n]})
+        assert len(exe._cache) == warm_keys
+        # beyond the largest bucket: served unpadded (new shape) and
+        # counted as overflow
+        before = _counter("paddle_serving_bucket_overflow_total")
+        eng.run({"x": feed[:9]})
+        assert len(exe._cache) == warm_keys + 1
+        assert _counter("paddle_serving_bucket_overflow_total") \
+            == before + 1
+
+    def test_warmup_reports_buckets_and_counts_compiles(self, tmp_path):
+        d, _, _ = _export(tmp_path)
+        before4 = _counter("paddle_serving_bucket_compiles_total",
+                           bucket="4")
+        eng = ServingEngine(d, buckets=(2, 4), warmup=False)
+        assert eng.warmup() == [2, 4]
+        assert _counter("paddle_serving_bucket_compiles_total",
+                        bucket="4") == before4 + 1
+
+    def test_replicas_round_robin(self, tmp_path):
+        d, feed, want = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(8,), replicas=3, warmup=False)
+        assert len(eng.replicas) == 3
+        devs = {rep.device for rep in eng.replicas}
+        assert len(devs) == 3  # conftest forces 8 virtual devices
+        for i in range(6):  # every replica serves, results identical
+            got, = eng.run({"x": feed[:2]})
+            np.testing.assert_allclose(got, want[:2], rtol=1e-5,
+                                       atol=1e-6)
+
+    def test_feed_validation(self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=False)
+        with pytest.raises(KeyError):
+            eng.run({"y": feed[:2]})
+        with pytest.raises(ValueError):
+            eng.run({"x": np.float32(1.0)})
+
+    def test_quantized_and_merged_model_load(self, tmp_path):
+        from paddle_tpu.utils.merge_model import merge_inference_model
+        d, feed, want = _export(tmp_path, quantize="int8")
+        merged = merge_inference_model(d, str(tmp_path / "m.ptpu"))
+        eng = ServingEngine(merged, buckets=(8,), warmup=False)
+        got, = eng.run({"x": feed[:5]})
+        np.testing.assert_allclose(got, want[:5], atol=0.02)
+
+
+class TestMicroBatcher:
+    def test_coalesces_queued_singles(self, tmp_path):
+        d, feed, want = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(8,), warmup=True)
+        req0 = _counter("paddle_serving_requests_total")
+        bat0 = sum(
+            c.value for c in metrics.REGISTRY._families[
+                "paddle_serving_batches_total"].children().values())
+        mb = MicroBatcher(eng, max_delay_ms=50.0, autostart=False)
+        futs = [mb.submit({"x": feed[i]}) for i in range(8)]
+        mb.start()  # everything is queued: one full batch
+        try:
+            for i, f in enumerate(futs):
+                out, = f.result(timeout=30)
+                np.testing.assert_allclose(out, want[i], rtol=1e-5,
+                                           atol=1e-6)
+        finally:
+            mb.close()
+        n_req = _counter("paddle_serving_requests_total") - req0
+        n_bat = sum(
+            c.value for c in metrics.REGISTRY._families[
+                "paddle_serving_batches_total"].children().values()) \
+            - bat0
+        assert n_req == 8 and n_bat == 1  # occupancy 8
+
+    def test_concurrent_threads_occupancy_and_correctness(self,
+                                                          tmp_path):
+        """ISSUE satellite: N threads submitting singles observe mean
+        occupancy > 1 and each gets its own (order-independent)
+        output, including across multiple flushed batches."""
+        d, feed, want = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(1, 4, 8), warmup=True)
+        req0 = _counter("paddle_serving_requests_total")
+        bat0 = sum(
+            c.value for c in metrics.REGISTRY._families[
+                "paddle_serving_batches_total"].children().values())
+        n_threads, per_thread = 6, 4
+        results = {}
+        errors = []
+        mb = MicroBatcher(eng, max_delay_ms=25.0, autostart=False)
+
+        def client(tid):
+            try:
+                futs = []
+                for i in range(per_thread):
+                    idx = tid * per_thread + i
+                    futs.append((idx, mb.submit({"x": feed[idx]})))
+                for idx, fut in futs:
+                    results[idx] = fut.result(timeout=30)[0]
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)  # let submissions pile up before serving
+        mb.start()
+        for t in threads:
+            t.join()
+        mb.close()
+        assert not errors
+        assert len(results) == n_threads * per_thread
+        for idx, out in results.items():
+            np.testing.assert_allclose(out, want[idx], rtol=1e-5,
+                                       atol=1e-6)
+        n_req = _counter("paddle_serving_requests_total") - req0
+        n_bat = sum(
+            c.value for c in metrics.REGISTRY._families[
+                "paddle_serving_batches_total"].children().values()) \
+            - bat0
+        assert n_req == n_threads * per_thread
+        assert n_req / n_bat > 1.0, "requests never coalesced"
+
+    def test_deadline_flushes_partial_batch(self, tmp_path):
+        d, feed, want = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(1, 8), warmup=True)
+        with MicroBatcher(eng, max_delay_ms=20.0) as mb:
+            t0 = time.perf_counter()
+            out, = mb.submit({"x": feed[0]}).result(timeout=30)
+            dt = time.perf_counter() - t0
+        np.testing.assert_allclose(out, want[0], rtol=1e-5, atol=1e-6)
+        assert dt < 10.0  # flushed by deadline, not by a full batch
+
+    def test_backpressure(self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(8,), warmup=False)
+        mb = MicroBatcher(eng, max_queue=2, autostart=False)
+        mb.submit({"x": feed[0]})
+        mb.submit({"x": feed[1]})
+        with pytest.raises(ServingOverloadError):
+            mb.submit({"x": feed[2]}, timeout=0.01)
+        mb.start()
+        mb.close()
+
+    def test_close_fails_unserved_futures(self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(8,), warmup=False)
+        mb = MicroBatcher(eng, autostart=False)
+        fut = mb.submit({"x": feed[0]})
+        mb.close()  # dispatcher never ran: future must fail, not hang
+        with pytest.raises(RuntimeError):
+            fut.result(timeout=5)
+
+    def test_closed_batcher_rejects(self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(8,), warmup=False)
+        mb = MicroBatcher(eng)
+        mb.close()
+        with pytest.raises(RuntimeError):
+            mb.submit({"x": feed[0]})
+
+
+class TestInferEngineCache:
+    def test_one_shot_reuses_engine(self, tmp_path):
+        from paddle_tpu import inference
+        d, feed, want = _export(tmp_path)
+        inference.clear_engine_cache()
+        out1 = ptpu.inference.infer(d, {"x": feed[:2]})
+        assert len(inference._ENGINE_CACHE) == 1
+        engine = next(iter(inference._ENGINE_CACHE.values()))
+        out2 = ptpu.inference.infer(d, {"x": feed[:2]})
+        assert next(iter(inference._ENGINE_CACHE.values())) is engine
+        np.testing.assert_allclose(out1, want[:2], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(out1, out2)
+
+    def test_reexport_invalidates(self, tmp_path):
+        from paddle_tpu import inference
+        d, feed, _ = _export(tmp_path)
+        inference.clear_engine_cache()
+        ptpu.inference.infer(d, {"x": feed[:2]})
+        key1 = next(iter(inference._ENGINE_CACHE))
+        # a re-export bumps __model__ mtime -> different cache key
+        st = os.stat(os.path.join(d, "__model__"))
+        os.utime(os.path.join(d, "__model__"),
+                 ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+        ptpu.inference.infer(d, {"x": feed[:2]})
+        assert len(inference._ENGINE_CACHE) == 2
+        assert next(reversed(inference._ENGINE_CACHE)) != key1
+        inference.clear_engine_cache()
+
+
+class TestCapiBucketedServing:
+    def test_forward_through_serving_engine(self, tmp_path):
+        from paddle_tpu import capi_bridge
+        d, feed, want = _export(tmp_path)
+        h = capi_bridge.load_model(d, batch_buckets=(4,))
+        try:
+            outs = capi_bridge.forward(
+                h, [("x", feed[:2].tobytes(), feed[:2].shape, 0)])
+        finally:
+            capi_bridge.release(h)
+        name, arr, shape = outs[0]
+        assert shape == [2, 10]
+        np.testing.assert_allclose(
+            np.frombuffer(arr, "float32").reshape(2, 10), want[:2],
+            rtol=1e-5, atol=1e-6)
+
+
+class TestEndToEndAcceptance:
+    def test_export_quantize_serve_concurrently(self, tmp_path):
+        """ISSUE acceptance: export -> int8-quantize -> ServingEngine ->
+        concurrent submit() matches the unbatched f32 path within
+        tolerance, with occupancy > 1 and serving metrics visible."""
+        d8, feed, want_f32 = _export(tmp_path, quantize="int8")
+        eng = ServingEngine(d8, buckets=(1, 4, 8), replicas=2,
+                            warmup=True)
+        req0 = _counter("paddle_serving_requests_total")
+        bat0 = sum(
+            c.value for c in metrics.REGISTRY._families[
+                "paddle_serving_batches_total"].children().values())
+        results = {}
+        mb = MicroBatcher(eng, max_delay_ms=25.0, autostart=False)
+
+        def client(tid):
+            futs = [(tid * 4 + i, mb.submit({"x": feed[tid * 4 + i]}))
+                    for i in range(4)]
+            for idx, fut in futs:
+                results[idx] = fut.result(timeout=30)[0]
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        mb.start()
+        for t in threads:
+            t.join()
+        mb.close()
+
+        assert len(results) == 16
+        for idx, out in results.items():  # int8 vs unbatched f32
+            np.testing.assert_allclose(out, want_f32[idx], atol=0.02)
+        n_req = _counter("paddle_serving_requests_total") - req0
+        n_bat = sum(
+            c.value for c in metrics.REGISTRY._families[
+                "paddle_serving_batches_total"].children().values()) \
+            - bat0
+        assert n_req == 16 and n_req / n_bat > 1.0
+        # serving families are visible through the exposition surface
+        text = metrics.REGISTRY.expose_text()
+        for fam in ("paddle_serving_requests_total",
+                    "paddle_serving_batches_total",
+                    "paddle_serving_batch_occupancy",
+                    "paddle_serving_request_seconds",
+                    "paddle_serving_queue_depth"):
+            assert fam in text
