@@ -420,6 +420,74 @@ def bench_resnet_pipeline(on_accel):
     }
 
 
+def bench_checkpoint(on_accel):
+    """Checkpoint save+verify+restore latency through the crash-safe
+    path (io.py: temp-dir write, sha256 manifest, atomic publish,
+    digest-verified load). Reported as roundtrips/sec so the
+    regression tripwire (higher-is-better) watches it — a silent 10%
+    slowdown in the checkpoint path taxes every training job's step
+    budget."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+    from paddle_tpu.models import resnet
+
+    res = 224 if on_accel else 32
+    depth = 50 if on_accel else 20
+
+    main_prog, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main_prog, startup):
+        img = layers.data("img", shape=[3, res, res])
+        label = layers.data("label", shape=[1], dtype="int64")
+        if on_accel:
+            loss, _, _ = resnet.resnet_imagenet(img, label, depth=depth)
+        else:
+            loss, _, _ = resnet.resnet_cifar10(img, label, depth=depth)
+        ptpu.optimizer.Momentum(learning_rate=0.1, momentum=0.9) \
+            .minimize(loss, startup_program=startup)
+
+    exe = ptpu.Executor()
+    exe.run(startup)
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        from paddle_tpu import io as pio
+        # warm (first save pays makedirs etc.)
+        pio.save_checkpoint(exe, ckpt_dir, 0, main_prog)
+        reps = 5
+        t_save = t_load = 0.0
+        for i in range(1, reps + 1):
+            t0 = time.perf_counter()
+            pio.save_checkpoint(exe, ckpt_dir, i, main_prog)
+            t1 = time.perf_counter()
+            loaded = pio.load_checkpoint(exe, ckpt_dir, main_prog)
+            t2 = time.perf_counter()
+            if loaded != i:
+                raise RuntimeError("checkpoint roundtrip loaded step "
+                                   "%r, expected %d" % (loaded, i))
+            t_save += t1 - t0
+            t_load += t2 - t1
+        state_bytes = sum(
+            os.path.getsize(os.path.join(ckpt_dir,
+                                         "checkpoint_%d" % reps, f))
+            for f in os.listdir(os.path.join(ckpt_dir,
+                                             "checkpoint_%d" % reps)))
+        rt = reps / (t_save + t_load)
+        return {
+            "metric": "checkpoint_roundtrips_per_sec" if on_accel else
+                      "checkpoint_roundtrips_per_sec_cpu_smoke",
+            "value": round(rt, 2),
+            "unit": "save+verify+restore/sec",
+            "vs_baseline": 1.0,  # no reference analog; tripwire-only
+            "save_ms": round(t_save / reps * 1e3, 1),
+            "verify_restore_ms": round(t_load / reps * 1e3, 1),
+            "state_mb": round(state_bytes / 1e6, 1),
+        }
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
 def _isolated(fn):
     """Run one bench in a private Scope + name namespace and release
     its device state afterwards (the 740M-param transformer's Adam
@@ -448,7 +516,9 @@ def main():
             ("transformer_lm_train_tokens_per_sec",
              lambda: bench_transformer_lm(on_accel, peak)),
             ("resnet_pipeline_overlap",
-             lambda: bench_resnet_pipeline(on_accel))]:
+             lambda: bench_resnet_pipeline(on_accel)),
+            ("checkpoint_roundtrips_per_sec",
+             lambda: bench_checkpoint(on_accel))]:
         try:
             print(json.dumps(annotate_regression(_isolated(fn),
                                                  prev_metrics)),

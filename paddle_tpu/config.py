@@ -34,6 +34,33 @@ telemetry: if True, arm the observability layer (observability/):
   throughput metrics, staging queue/arena gauges, and host trace spans
   into the Chrome-trace ring buffer. Off (default), the per-step cost of
   the instrumentation is a flag check — no spans, no metric updates.
+
+nonfinite_guard: if True, the executor wraps the donated state update in
+  a finite-check select: when any inexact fetched value (loss/metrics)
+  is NaN/Inf, the step becomes an identity update — params and optimizer
+  state keep their pre-step values ON DEVICE (RNG still advances so a
+  retried batch sees fresh randomness). This is what makes the
+  resilience skip/rollback policies safe under donation: by the time the
+  host sees the NaN, the update would otherwise already be applied.
+  Keyed into the executor compile cache like every trace-time flag.
+
+nonfinite_policy / nonfinite_budget: defaults for
+  resilience.RecoveryPolicy — what a ResilientTrainer does on a
+  non-finite step ('raise' | 'skip' | 'rollback') and how many
+  CONSECUTIVE non-finite steps it tolerates before giving up and
+  raising (a finite step resets the count: the budget distinguishes
+  divergence from isolated glitches).
+
+reader_retries: default retry budget for the resilient reader wrapper
+  (transient OSError-family reader failures are retried with exponential
+  backoff; the pass resumes at the first unconsumed sample).
+
+step_deadline_sec: default hung-step watchdog deadline for
+  ResilientTrainer (0 = watchdog off).
+
+fault_injection: master switch for resilience.faults — with it False
+  (default) every armed fault is inert and each hook site costs one
+  flag check. Chaos tests/probes arm it explicitly.
 """
 
 import jax
@@ -47,6 +74,13 @@ _flags = {
     "flash_attention": False,
     "telemetry": False,
     "serving_buckets": (1, 8, 32),
+    # resilience (resilience/supervisor.py defaults; see docstring)
+    "nonfinite_guard": False,
+    "nonfinite_policy": "raise",
+    "nonfinite_budget": 8,
+    "reader_retries": 3,
+    "step_deadline_sec": 0,
+    "fault_injection": False,
 }
 
 # Observers called with the flag dict after every set_flags (the

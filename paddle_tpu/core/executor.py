@@ -333,6 +333,7 @@ class Executor:
 
         from .. import config as _config
         check_nan_inf = bool(_config.get_flag("check_nan_inf"))
+        nonfinite_guard = bool(_config.get_flag("nonfinite_guard"))
         amp = _config.get_flag("amp")
         flash = bool(_config.get_flag("flash_attention"))
         precision = _config.get_flag("matmul_precision")
@@ -342,14 +343,15 @@ class Executor:
         key = (program._uid, program._version, feed_sig, tuple(fetch_names),
                bool(donate_state),
                self.strategy._uid if self.strategy is not None else None,
-               check_nan_inf, amp, flash, precision)
+               check_nan_inf, amp, flash, precision, nonfinite_guard)
         telemetry = bool(_config.get_flag("telemetry"))
         entry = self._cache.get(key)
         if entry is None:
             if telemetry and count_cache:
                 _CACHE_MISSES.inc()
             built = self._build(program, block, feed_sig, fetch_names,
-                                donate_state, check_nan_inf, amp)
+                                donate_state, check_nan_inf, amp,
+                                nonfinite_guard)
             entry = _CacheEntry(*built, key_id="k%d" % next(_KEY_IDS))
             self._cache[key] = entry
         elif telemetry and count_cache:
@@ -517,7 +519,7 @@ class Executor:
         return fn, (state, feed)
 
     def _build(self, program, block, feed_sig, fetch_names, donate_state,
-               check_nan_inf=False, amp=None):
+               check_nan_inf=False, amp=None, nonfinite_guard=False):
         read, written, needs_rng = _block_io(block)
         if needs_rng:
             written.add(RNG_STATE_VAR)
@@ -550,6 +552,21 @@ class Executor:
                 _parallel.set_current_strategy(prev)
             new_state = {n: env[n] for n in written_t if n in env}
             fetches = [_lookup(env, n, None, block) for n in fetch_names]
+            if nonfinite_guard:
+                # Guarded donated update (resilience/supervisor.py): if
+                # any inexact fetch is non-finite the whole state update
+                # becomes identity — a poisoned batch cannot corrupt
+                # donated params/optimizer state. RNG is exempt so a
+                # retried batch draws fresh randomness.
+                ok = jnp.asarray(True)
+                for v in fetches:
+                    v = jnp.asarray(v)
+                    if jnp.issubdtype(v.dtype, jnp.inexact):
+                        ok = jnp.logical_and(ok, jnp.isfinite(v).all())
+                new_state = {
+                    n: (v if n == RNG_STATE_VAR or n not in state_rw
+                        else jnp.where(ok, v, state_rw[n]))
+                    for n, v in new_state.items()}
             return new_state, fetches, trace.nan_guards or {}
 
         jit_kwargs = {}

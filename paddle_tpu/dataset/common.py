@@ -164,7 +164,12 @@ class Synthesizer:
     """Deterministic synthetic sample stream."""
 
     def __init__(self, name, split, n):
-        seed = (hash((name, split)) & 0x7FFFFFFF) or 1
+        # crc32, NOT hash(): str hashes are salted per process
+        # (PYTHONHASHSEED), which made every run draw different
+        # synthetic data and marginal convergence asserts flaky
+        import zlib
+        key = ("%s/%s" % (name, split)).encode()
+        seed = (zlib.crc32(key) & 0x7FFFFFFF) or 1
         self.rs = np.random.RandomState(seed)
         self.n = n
 

@@ -56,11 +56,25 @@ class MasterClient:
         self.addr = (host, port)
         self.retries = retries
         self._sock = None
+        self._file = None
 
     def _connect(self):
         s = socket.create_connection(self.addr, timeout=10)
         self._file = s.makefile("r")
         self._sock = s
+
+    def _close(self):
+        """Release the socket AND its makefile wrapper — dropping the
+        references without close() leaks both fds on every
+        reconnect/failure until GC happens to run."""
+        for f in (self._file, self._sock):
+            if f is not None:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+        self._file = None
+        self._sock = None
 
     def _call(self, line):
         for attempt in range(self.retries):
@@ -73,7 +87,7 @@ class MasterClient:
                     return resp.strip()
             except OSError:
                 pass
-            self._sock = None
+            self._close()
             time.sleep(0.2 * (attempt + 1))
         raise ConnectionError("master unreachable at %s:%d" % self.addr)
 
@@ -154,8 +168,17 @@ class ElasticDataDispatcher:
         import pickle
         de = deserialize or pickle.loads
 
+        from ..resilience import faults as _faults
+
         def gen():
+            leases = 0
             while True:
+                # chaos hook: "kill master mid-pass" — arm with a
+                # callback that kills (and restarts) the MasterServer;
+                # the client's retry loop + the master's disk snapshot
+                # carry the pass across the outage
+                _faults.fire_point("master_kill", leases)
+                leases += 1
                 task = self.client.get_task(self.worker_id)
                 if task == "ALLDONE":
                     return

@@ -10,17 +10,42 @@ var list; inference export serializes the Program as versioned JSON
 gather to host transparently (np.asarray on a sharded jax.Array).
 """
 
+import hashlib
 import json
 import os
+import re
+import shutil
+import time
 
 import numpy as np
 
 from .core.framework import Program, Parameter, RNG_STATE_VAR
 from .core.scope import global_scope
+from .observability import metrics as _metrics
+from .resilience import faults as _faults
+from .utils import log as _log
 
 __all__ = ["save_params", "load_params", "save_persistables",
            "load_persistables", "save_checkpoint", "load_checkpoint",
+           "load_checkpoint_meta", "verify_checkpoint",
            "save_inference_model", "load_inference_model", "prune_program"]
+
+# Recovery observability (always-on: these fire on rare events, never in
+# the per-step hot path).
+_CKPT_FALLBACKS = _metrics.REGISTRY.counter(
+    "paddle_checkpoint_fallbacks_total",
+    "Loads that fell back past a corrupt/missing newest checkpoint to "
+    "an older intact one")
+_CKPT_QUARANTINED = _metrics.REGISTRY.counter(
+    "paddle_checkpoint_quarantined_total",
+    "Checkpoint dirs renamed to corrupt_* after failing digest/load "
+    "verification")
+_CKPT_VERIFY_SECONDS = _metrics.REGISTRY.histogram(
+    "paddle_checkpoint_verify_seconds",
+    "Wall time of one checkpoint digest verification")
+
+_CKPT_RE = re.compile(r"checkpoint_(\d+)$")
+_MANIFEST = "manifest.json"
 
 
 def _select_vars(program, predicate):
@@ -91,35 +116,229 @@ def load_persistables(executor, dirname, main_program=None,
     return _load(dirname, filename, scope or global_scope())
 
 
+def _sha256_file(path, bufsize=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(bufsize)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def _fsync_path(path):
+    """Best-effort fsync of a file's pages or a directory's entries
+    (a rename is only power-loss durable once its parent dir inode is
+    synced; some filesystems refuse dir fsync, hence best-effort)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _write_json_atomic(path, obj):
+    """tmp + os.replace (+ parent-dir fsync): readers never see a
+    torn/truncated JSON, and the replace survives power loss."""
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_path(os.path.dirname(path) or ".")
+
+
 def save_checkpoint(executor, dirname, step, main_program=None, scope=None,
-                    keep_last=3):
-    """Per-step checkpoint dirs with resume meta (legacy per-pass dirs +
-    Go pserver checkpoint meta, SURVEY §5.3/§5.4)."""
+                    keep_last=3, extra_meta=None):
+    """Crash-safe per-step checkpoint dirs with resume meta (legacy
+    per-pass dirs + Go pserver checkpoint meta, SURVEY §5.3/§5.4).
+
+    The checkpoint is written into a temp dir and published with one
+    atomic rename, so a process killed at ANY point during the save
+    never leaves a half-written ``checkpoint_<step>`` for
+    ``load_checkpoint`` to trip over. A ``manifest.json`` inside the
+    dir records the per-file sha256 digests that ``load_checkpoint``
+    verifies before trusting the state. ``extra_meta`` (e.g. preemption
+    resume info) is merged into ``latest.json``, itself replaced
+    atomically."""
+    os.makedirs(dirname, exist_ok=True)
     cdir = os.path.join(dirname, "checkpoint_%d" % step)
-    save_persistables(executor, cdir, main_program, scope=scope)
-    with open(os.path.join(dirname, "latest.json"), "w") as f:
-        json.dump({"step": step, "dir": cdir}, f)
+    # sweep stale temp dirs from past crashed/killed writers, whatever
+    # their pid (concurrent savers into one dir are unsupported anyway
+    # — they'd already race latest.json): each one is a full-size copy
+    # of the model state and would otherwise leak disk forever
+    for d in os.listdir(dirname):
+        if d.startswith("_tmp_checkpoint_"):
+            shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
+    tmp = os.path.join(dirname, "_tmp_checkpoint_%d.%d"
+                       % (step, os.getpid()))
+    try:
+        save_persistables(executor, tmp, main_program, scope=scope)
+        for fn in os.listdir(tmp):
+            # flush the data pages too — without this the rename below
+            # is durable but the npz it publishes may not be
+            _fsync_path(os.path.join(tmp, fn))
+        digests = {fn: _sha256_file(os.path.join(tmp, fn))
+                   for fn in sorted(os.listdir(tmp))}
+        _write_json_atomic(os.path.join(tmp, _MANIFEST),
+                           {"step": step, "digests": digests})
+        # chaos hook: everything written, nothing published — the
+        # window a preempted/killed writer most often dies in
+        _faults.fire_point("checkpoint_crash", step)
+        if os.path.isdir(cdir):  # re-checkpoint of the same step
+            shutil.rmtree(cdir, ignore_errors=True)
+        os.rename(tmp, cdir)  # the publish point (atomic within a fs)
+        _fsync_path(dirname)  # make the publish power-loss durable
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    meta = {"step": step, "dir": cdir}
+    meta.update(extra_meta or {})
+    _write_json_atomic(os.path.join(dirname, "latest.json"), meta)
     # prune old (skip foreign dirs that don't match checkpoint_<int>;
     # keep_last<=0 means keep everything)
     if keep_last > 0:
-        import re
-        import shutil
         kept = sorted([d for d in os.listdir(dirname)
-                       if re.fullmatch(r"checkpoint_\d+", d)],
+                       if _CKPT_RE.fullmatch(d)],
                       key=lambda d: int(d.split("_")[1]))
         for d in kept[:-keep_last]:
             shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
+        # quarantined dirs are evidence, but bounded evidence: each is
+        # a full-size copy of the model state, so keep only the newest
+        # few or a flaky disk fills the checkpoint volume
+        corrupt = sorted(
+            (d for d in os.listdir(dirname) if d.startswith("corrupt_")),
+            key=lambda d: os.path.getmtime(os.path.join(dirname, d)))
+        for d in corrupt[:-2]:
+            shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
+
+
+def verify_checkpoint(cdir):
+    """Digest-verify one checkpoint dir. Returns (ok, reason)."""
+    t0 = time.perf_counter()
+    try:
+        mpath = os.path.join(cdir, _MANIFEST)
+        if not os.path.isdir(cdir):
+            return False, "missing dir"
+        if not os.path.exists(mpath):
+            # pre-manifest (seed-era) checkpoint: loadable but not
+            # verifiable — accept when the data files at least exist
+            if os.path.exists(os.path.join(cdir, "persistables.npz")):
+                return True, "legacy (no manifest)"
+            return False, "no manifest and no persistables"
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except ValueError:
+            return False, "unreadable manifest"
+        for fn, want in sorted(manifest.get("digests", {}).items()):
+            path = os.path.join(cdir, fn)
+            if not os.path.exists(path):
+                return False, "missing file %s" % fn
+            if _sha256_file(path) != want:
+                return False, "digest mismatch on %s" % fn
+        return True, "ok"
+    finally:
+        _CKPT_VERIFY_SECONDS.observe(time.perf_counter() - t0)
+
+
+def _quarantine(cdir, reason):
+    """Move a corrupt checkpoint aside (never delete evidence)."""
+    base = os.path.dirname(cdir)
+    dst = os.path.join(base, "corrupt_" + os.path.basename(cdir))
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = os.path.join(base, "corrupt_%s.%d"
+                           % (os.path.basename(cdir), n))
+    try:
+        os.rename(cdir, dst)
+    except OSError:
+        return
+    _CKPT_QUARANTINED.inc()
+    _log.structured("checkpoint_quarantined", dir=cdir, reason=reason,
+                    moved_to=dst)
+
+
+def _checkpoint_candidates(dirname):
+    """(step, dir) candidates, newest first. latest.json is a HINT, not
+    an override: its target is promoted to the front only when it is at
+    least as new as everything the directory scan found — a crash
+    between the atomic checkpoint publish and the latest.json rewrite
+    leaves latest pointing one step behind, and resuming from it would
+    silently discard a fully intact newer checkpoint."""
+    steps = {}
+    try:
+        entries = os.listdir(dirname)
+    except OSError:
+        return []
+    for d in entries:
+        m = _CKPT_RE.fullmatch(d)
+        if m:
+            steps[int(m.group(1))] = os.path.join(dirname, d)
+    out = sorted(steps.items(), reverse=True)
+    meta = load_checkpoint_meta(dirname)
+    if meta and isinstance(meta.get("step"), int) and \
+            (not out or meta["step"] >= out[0][0]):
+        # prefer the scanned on-disk path for that step: latest.json's
+        # stored 'dir' goes stale when the checkpoint tree is moved or
+        # was saved under a different cwd — substituting it would
+        # discard a perfectly intact newest checkpoint
+        pair = (meta["step"],
+                steps.get(meta["step"]) or meta.get("dir") or
+                os.path.join(dirname, "checkpoint_%d" % meta["step"]))
+        out = [pair] + [p for p in out if p[0] != meta["step"]]
+    return out
+
+
+def load_checkpoint_meta(dirname):
+    """The latest.json dict (step/dir plus any resume metadata saved by
+    a preempted trainer), or None when missing/unreadable."""
+    try:
+        with open(os.path.join(dirname, "latest.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def load_checkpoint(executor, dirname, main_program=None, scope=None):
-    """Load the newest checkpoint; returns its step (or None)."""
-    meta_path = os.path.join(dirname, "latest.json")
-    if not os.path.exists(meta_path):
-        return None
-    with open(meta_path) as f:
-        meta = json.load(f)
-    load_persistables(executor, meta["dir"], main_program, scope=scope)
-    return meta["step"]
+    """Load the newest INTACT checkpoint; returns its step (or None).
+
+    Every candidate is digest-verified first (``manifest.json``); a
+    corrupt or vanished newest checkpoint — truncated file, pruned dir
+    that latest.json still points at, torn write from a pre-atomic
+    writer — is quarantined to ``corrupt_*`` and the next older intact
+    one is loaded instead. Fallbacks and quarantines are counted in the
+    metrics registry (``paddle_checkpoint_*``)."""
+    candidates = _checkpoint_candidates(dirname)
+    for i, (step, cdir) in enumerate(candidates):
+        ok, reason = verify_checkpoint(cdir)
+        if ok:
+            try:
+                load_persistables(executor, cdir, main_program,
+                                  scope=scope)
+            except Exception as e:  # verified yet unloadable: quarantine
+                ok, reason = False, "load failed: %r" % (e,)
+            else:
+                if i > 0:
+                    _CKPT_FALLBACKS.inc()
+                    _log.structured(
+                        "checkpoint_fallback", loaded=cdir, step=step,
+                        skipped=[c for _, c in candidates[:i]])
+                return step
+        if os.path.isdir(cdir):
+            _quarantine(cdir, reason)
+        else:
+            _log.structured("checkpoint_skipped", dir=cdir,
+                            reason=reason)
+    return None
 
 
 def prune_program(program, fetch_names):

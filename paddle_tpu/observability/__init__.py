@@ -19,6 +19,14 @@ All hooks are gated by the config flag ``telemetry``
 flag check. Setting the flag also arms the span ring buffer, so
 ``timer()``/``RecordEvent`` call sites across the codebase record trace
 events with no further setup.
+
+Recovery events are the exception to the gating: the resilience layer's
+counters (``paddle_resilience_*`` from ``resilience/supervisor.py`` —
+non-finite/skipped/rolled-back steps, reader retries, watchdog stalls,
+preemptions — and ``paddle_checkpoint_*`` from ``io.py`` — fallbacks,
+quarantines, verify time) record unconditionally, like the serving
+metrics: they fire on rare events, never per step, and an operator
+debugging a flapping job needs them present without re-running armed.
 """
 
 from . import metrics  # noqa: F401

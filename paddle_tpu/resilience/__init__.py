@@ -1,0 +1,41 @@
+"""Fault-tolerant training: supervisor, recovery policies, chaos tools.
+
+At production scale restarts and partial failures are the steady state
+(ROADMAP north star), so recovery is designed and tested here rather
+than accidental:
+
+* ``supervisor``  — :class:`ResilientTrainer`: non-finite-loss policies
+  (skip-with-budget / rollback-with-LR-backoff, safe under donated
+  state via the executor's ``nonfinite_guard``), retrying reader
+  wrapper, SIGTERM/SIGINT preemption that checkpoints and exits with
+  resume metadata, and a hung-step watchdog.
+* ``faults``      — deterministic fault-injection registry driving the
+  chaos tests (``tests/test_resilience.py``) and the headless probe
+  (``tools/chaos_probe.py``).
+* crash-safe checkpoints live in ``paddle_tpu.io``: temp-dir +
+  atomic-rename publish, sha256 manifests, verified load with fallback
+  to the newest intact checkpoint.
+
+Every recovery event is a counter in the observability registry
+(``paddle_resilience_*`` / ``paddle_checkpoint_*``).
+
+NOTE: only ``faults`` is imported eagerly — ``supervisor`` pulls in the
+trainer stack, and ``io`` imports this package for its chaos hook, so
+the heavy import is deferred via module ``__getattr__``.
+"""
+
+from . import faults  # noqa: F401  (light: config + logging only)
+
+_SUPERVISOR_EXPORTS = ("ResilientTrainer", "RecoveryPolicy",
+                       "resilient_reader", "StepWatchdog",
+                       "preemption_guard")
+
+__all__ = ["faults"] + list(_SUPERVISOR_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _SUPERVISOR_EXPORTS:
+        from . import supervisor
+        return getattr(supervisor, name)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

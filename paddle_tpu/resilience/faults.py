@@ -1,0 +1,164 @@
+"""Deterministic fault injection for chaos testing.
+
+The registry maps a *site* (a string naming a hook point compiled into
+the production code path) to armed :class:`FaultSpec` s. Hook sites call
+``fire_point(site, index)`` — a no-op unless the ``fault_injection``
+config flag is on AND a spec armed for that site matches ``index``
+(step / batch number). Matching specs execute their action exactly
+``times`` times, so chaos tests are reproducible: "NaN loss at step 3",
+"reader IOError at batch 5", "SIGKILL during checkpoint write at step
+6" — no sleeps-and-hope.
+
+Built-in hook sites:
+
+============================  =============================================
+site                          where / what
+============================  =============================================
+``nan_loss``                  ResilientTrainer poisons the feed (first
+                              float array -> NaN) before the step, so the
+                              NaN propagates through the REAL computation
+``reader_error``              the reader raises the armed exception
+                              (default IOError — inside the resilient
+                              reader's transient set) before yielding
+                              sample ``index``
+``checkpoint_crash``          io.save_checkpoint, after the checkpoint
+                              data is fully written into the temp dir but
+                              BEFORE the atomic rename publishes it
+``master_kill``               ElasticDataDispatcher.reader, once per task
+                              lease — arm with a callback that kills (and
+                              optionally restarts) the master
+============================  =============================================
+
+Actions: ``"raise"`` (raise ``exc``, default :class:`InjectedFault`),
+``"kill"`` (``os.kill(os.getpid(), SIGKILL)`` — the real
+process-death simulation for subprocess chaos tests), or
+``"callback"`` (run an arbitrary callable, e.g. kill a helper daemon).
+"""
+
+import os
+import signal
+import threading
+
+from .. import config as _config
+from ..utils import log as _log
+
+__all__ = ["InjectedFault", "arm", "disarm", "armed", "should_fire",
+           "fire_point", "poison_feed"]
+
+
+class InjectedFault(Exception):
+    """Raised by an armed ``action="raise"`` fault."""
+
+
+class FaultSpec:
+    __slots__ = ("site", "at", "times", "action", "exc", "callback")
+
+    def __init__(self, site, at=None, times=1, action="raise", exc=None,
+                 callback=None):
+        if action not in ("raise", "kill", "callback"):
+            raise ValueError("unknown fault action %r" % (action,))
+        if action == "callback" and callback is None:
+            raise ValueError("action='callback' needs a callback")
+        self.site = site
+        self.at = at          # index (step/batch) to fire at; None = any
+        self.times = times    # remaining firings
+        self.action = action
+        self.exc = exc
+        self.callback = callback
+
+
+_LOCK = threading.Lock()
+_ARMED = {}  # site -> [FaultSpec]
+
+
+def arm(site, at=None, times=1, action="raise", exc=None, callback=None):
+    """Arm a fault (also flips the ``fault_injection`` config flag on)."""
+    spec = FaultSpec(site, at=at, times=times, action=action, exc=exc,
+                     callback=callback)
+    with _LOCK:
+        _ARMED.setdefault(site, []).append(spec)
+    if not _config.get_flag("fault_injection"):
+        _config.set_flags(fault_injection=True)
+    return spec
+
+
+def disarm(site=None):
+    """Drop armed faults for ``site`` (or all of them). When nothing
+    remains armed, the ``fault_injection`` master switch is cleared too
+    — hook sites go back to one flag check, and ResilientTrainer stops
+    wrapping readers in the fault hook."""
+    with _LOCK:
+        if site is None:
+            _ARMED.clear()
+        else:
+            _ARMED.pop(site, None)
+        empty = not any(_ARMED.values())
+    if empty and _config.get_flag("fault_injection"):
+        _config.set_flags(fault_injection=False)
+
+
+def armed(site=None):
+    with _LOCK:
+        if site is None:
+            return {s: list(v) for s, v in _ARMED.items()}
+        return list(_ARMED.get(site, ()))
+
+
+def should_fire(site, index=None):
+    """The matching armed spec (consuming one firing), or None.
+
+    Cheap when disarmed: one config-flag check, no lock."""
+    if not _config.get_flag("fault_injection"):
+        return None
+    with _LOCK:
+        for spec in _ARMED.get(site, ()):
+            if spec.times <= 0:
+                continue
+            if spec.at is not None and index is not None \
+                    and spec.at != index:
+                continue
+            spec.times -= 1
+            return spec
+    return None
+
+
+def fire_point(site, index=None, default_exc=None):
+    """Hook-site entry: execute the armed action for ``site`` if any.
+
+    Returns the spec when a non-raising action fired (so the caller can
+    branch), None when nothing fired. ``default_exc`` lets a hook site
+    pick the exception class raised when the armed spec didn't name
+    one (e.g. the reader site defaults to IOError so the fault lands
+    in the resilient reader's transient set)."""
+    spec = should_fire(site, index)
+    if spec is None:
+        return None
+    _log.structured("fault_injected", site=site, index=index,
+                    action=spec.action)
+    if spec.action == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    if spec.action == "callback":
+        spec.callback()
+        return spec
+    if spec.exc is not None:
+        raise spec.exc
+    raise (default_exc or InjectedFault)(
+        "injected fault at %s[%s]" % (site, index))
+
+
+def poison_feed(feed, step):
+    """``nan_loss`` hook: overwrite the first float feed array with NaN
+    (in a copy) when armed for ``step``, so a genuinely non-finite loss
+    flows through the unmodified train computation."""
+    import numpy as np
+    if should_fire("nan_loss", step) is None:
+        return feed
+    _log.structured("fault_injected", site="nan_loss", index=step,
+                    action="poison")
+    out = dict(feed)
+    for name, v in out.items():
+        arr = np.asarray(v)
+        if np.issubdtype(arr.dtype, np.floating):
+            out[name] = np.full_like(arr, np.nan)
+            break
+    return out

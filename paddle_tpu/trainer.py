@@ -121,6 +121,10 @@ class Trainer:
         self.periodic_log_interval = periodic_log_interval
         self.step_id = 0
         self._initialized = False
+        # preemption: set by request_stop() (e.g. a SIGTERM handler —
+        # resilience/supervisor.py); checked at step boundaries so the
+        # in-flight step always completes before the loop exits
+        self._stop_reason = None
         # telemetry window: (first-step start time, examples since);
         # the throughput gauge is per-instance ("trainer" label) — two
         # Trainers must not clobber one label-less value
@@ -138,7 +142,17 @@ class Trainer:
                                        self.main_program)
             if step is not None:
                 self.step_id = step
+                self._sync_scheduler()
         self._initialized = True
+
+    def _sync_scheduler(self):
+        """Re-align the host-side LR schedule with a step_id that was
+        just set from a checkpoint (resume or rollback) — the
+        scheduler's counter is not part of the persisted scope state,
+        and left alone it would keep scheduling LRs for the step count
+        of the abandoned timeline."""
+        if self.scheduler is not None:
+            self.scheduler.step_num = self.step_id
 
     def _fetches(self):
         names = ["loss"] + sorted(self.metrics)
@@ -170,22 +184,57 @@ class Trainer:
         self.step_id += 1
         if self.scheduler is not None:
             self.scheduler.step()
+        if self.async_metrics:
+            metrics = dict(zip(names, vals))
+        else:
+            metrics = dict(zip(names, [np.asarray(v).item()
+                                       if np.asarray(v).size == 1 else
+                                       np.asarray(v) for v in vals]))
+        # recovery hook (ResilientTrainer) — runs BEFORE the periodic
+        # checkpoint trigger so a rollback decision can't be preempted
+        # by checkpointing the offending step first
+        metrics = self._post_step(metrics)
         if self.checkpoint_dir and self.checkpoint_every and \
+                metrics.get("rolled_back_to") is None and \
                 self.step_id % self.checkpoint_every == 0:
             self._save_checkpoint(telemetry)
-        if self.async_metrics:
-            return dict(zip(names, vals))
-        return dict(zip(names, [np.asarray(v).item()
-                                if np.asarray(v).size == 1 else
-                                np.asarray(v) for v in vals]))
+        return metrics
 
-    def _save_checkpoint(self, telemetry):
+    def _post_step(self, metrics):
+        """Per-step recovery hook; the base trainer is a no-op. A
+        subclass may inspect/annotate the metrics, roll state back
+        (setting ``rolled_back_to``), or raise."""
+        return metrics
+
+    def _save_checkpoint(self, telemetry, extra_meta=None):
         ck0 = time.perf_counter()
         with timer("saveCheckpoint"):
             _io.save_checkpoint(self.exe, self.checkpoint_dir,
-                                self.step_id, self.main_program)
+                                self.step_id, self.main_program,
+                                extra_meta=extra_meta)
         if telemetry:
             _CKPT_SECONDS.observe(time.perf_counter() - ck0)
+
+    # -- resilience hooks (resilience/supervisor.py drives these) ------------
+    def request_stop(self, reason="preempt"):
+        """Ask the train loop to stop at the next step boundary: the
+        in-flight step finishes, a final checkpoint (with resume
+        metadata) is written, and ``train`` returns the preemption
+        record. Signal-handler safe (only sets a flag)."""
+        self._stop_reason = reason
+
+    def restore_checkpoint(self):
+        """Reload the newest intact checkpoint into the scope and rewind
+        ``step_id`` to it (the rollback primitive). Returns the restored
+        step, or None when there is no checkpoint to restore."""
+        if not self.checkpoint_dir:
+            return None
+        step = _io.load_checkpoint(self.exe, self.checkpoint_dir,
+                                   self.main_program)
+        if step is not None:
+            self.step_id = step
+            self._sync_scheduler()
+        return step
 
     def _record_step(self, feed, t0, t1):
         """Telemetry-path step accounting (flag already checked).
@@ -227,7 +276,16 @@ class Trainer:
         device_put ahead of consumption (reader/staging.py — the async
         double-buffer DataProvider analog); falls back to the plain
         Python prefetch queue when the native arena is unavailable.
+
+        Returns None on normal completion. If ``request_stop`` fires
+        mid-pass (preemption), the loop finishes the in-flight step,
+        writes a final checkpoint whose ``latest.json`` carries the
+        resume metadata, and returns that metadata dict.
         """
+        # clear any stale stop BEFORE startup: a preemption signal
+        # landing during startup's (possibly long) checkpoint load must
+        # survive into the loop, not be wiped after it
+        self._stop_reason = None
         self.startup()
         event_handler = event_handler or (lambda e: None)
         staged = None
@@ -251,13 +309,19 @@ class Trainer:
                     batches = batched()
                     run_one = self.train_batch
                 last_metrics = {}
+                last_batch_id = -1
                 for batch_id, batch in enumerate(batches):
                     event_handler(BeginIteration(pass_id, batch_id))
                     with _tracing.span("trainStep"):
                         metrics = run_one(batch)
                     last_metrics = metrics
+                    last_batch_id = batch_id
                     event_handler(EndIteration(pass_id, batch_id,
                                                self.step_id, metrics))
+                    if self._stop_reason:
+                        break
+                if self._stop_reason:
+                    return self._preempt_exit(pass_id, last_batch_id)
                 if self.checkpoint_dir:
                     self._save_checkpoint(_config.get_flag("telemetry"))
                 event_handler(EndPass(pass_id, last_metrics))
@@ -269,6 +333,21 @@ class Trainer:
         finally:
             if staged is not None:
                 self._teardown_staged(staged, batches, exc_live)
+
+    def _preempt_exit(self, pass_id, batch_id):
+        """Preemption epilogue: one final checkpoint whose latest.json
+        records exactly where training stopped, so a restarted trainer
+        resumes at the interrupted step (the Go pserver's
+        checkpoint-on-SIGTERM discipline, SURVEY §5.4)."""
+        resume = {"preempted": True, "reason": self._stop_reason,
+                  "pass_id": pass_id, "batch_id": batch_id,
+                  "step": self.step_id}
+        if self.checkpoint_dir:
+            self._save_checkpoint(_config.get_flag("telemetry"),
+                                  extra_meta=resume)
+        _log.structured("train_preempted", **resume)
+        self._stop_reason = None
+        return resume
 
     @staticmethod
     def _teardown_staged(staged, batches, exc_live):

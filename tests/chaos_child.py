@@ -1,0 +1,116 @@
+"""Subprocess trainer driven by the chaos tests (test_resilience.py).
+
+Modes (argv[1]):
+
+* ``train-kill <ckpt_dir> <kill_step>`` — train with
+  ``checkpoint_every_n_steps=2`` and an armed ``checkpoint_crash``
+  fault (action=kill) at ``kill_step``: the process SIGKILLs ITSELF in
+  the window where the checkpoint data is fully written but not yet
+  atomically published. The parent asserts the death and that a
+  restart resumes from the previous intact checkpoint.
+* ``train-preempt <ckpt_dir>`` — train slowly, printing ``STEP <n>``
+  lines; the parent sends SIGTERM mid-pass, the supervisor finishes
+  the in-flight step, writes a final checkpoint with resume metadata
+  and this prints ``PREEMPTED {json}``.
+* ``resume <ckpt_dir>`` — construct a trainer over the same dir and
+  print ``RESUMED_STEP <n>`` plus the latest.json metadata, nothing
+  else: the parent diffs this against the pre-crash state.
+
+The net is a deterministic 4->8->1 regression smallnet; all modes
+build it identically so checkpoints interchange.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build():
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        h = layers.fc(x, 8, act="relu")
+        p = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(p, y))
+        ptpu.optimizer.SGD(learning_rate=0.05).minimize(
+            loss, startup_program=startup)
+    return main, startup, loss
+
+
+def reader(n_batches, sleep=0.0):
+    def gen():
+        for i in range(n_batches):
+            rs = np.random.RandomState(i)  # deterministic per batch
+            xb = rs.randn(8, 4).astype("float32")
+            yield {"x": xb,
+                   "y": (xb.sum(1, keepdims=True) * 0.5)
+                   .astype("float32")}
+            if sleep:
+                time.sleep(sleep)
+    return gen
+
+
+def main():
+    mode, ckpt_dir = sys.argv[1], sys.argv[2]
+    from paddle_tpu.resilience import (ResilientTrainer, RecoveryPolicy,
+                                       faults)
+    from paddle_tpu import io as pio
+    from paddle_tpu.trainer import EndIteration
+    main_prog, startup, loss = build()
+
+    if mode == "resume":
+        tr = ResilientTrainer(loss, main_program=main_prog,
+                              startup_program=startup,
+                              checkpoint_dir=ckpt_dir)
+        tr.startup()
+        print("RESUMED_STEP %d" % tr.step_id, flush=True)
+        print("META %s" % json.dumps(
+            pio.load_checkpoint_meta(ckpt_dir) or {}), flush=True)
+        return 0
+
+    if mode == "train-kill":
+        kill_step = int(sys.argv[3])
+        faults.arm("checkpoint_crash", at=kill_step, action="kill")
+        tr = ResilientTrainer(loss, main_program=main_prog,
+                              startup_program=startup,
+                              checkpoint_dir=ckpt_dir,
+                              checkpoint_every_n_steps=2)
+        tr.train(reader(50), num_passes=1, staging=False)
+        print("SURVIVED step=%d" % tr.step_id, flush=True)
+        return 1  # the armed kill should have fired before this
+
+    if mode == "train-preempt":
+        tr = ResilientTrainer(loss, main_program=main_prog,
+                              startup_program=startup,
+                              checkpoint_dir=ckpt_dir,
+                              checkpoint_every_n_steps=10)
+
+        def handler(e):
+            if isinstance(e, EndIteration):
+                print("STEP %d" % e.step_id, flush=True)
+
+        print("READY %d" % os.getpid(), flush=True)
+        result = tr.train(reader(400, sleep=0.05), num_passes=1,
+                          event_handler=handler, staging=False)
+        if result and result.get("preempted"):
+            print("PREEMPTED %s" % json.dumps(result), flush=True)
+            return 0
+        print("FINISHED_WITHOUT_PREEMPTION", flush=True)
+        return 1
+
+    print("unknown mode %r" % mode, flush=True)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,828 @@
+"""Chaos/resilience tests: crash-safe checkpoints, non-finite-step
+policies, reader retry, preemption, watchdog, fault registry
+(resilience/ + the hardened io.py checkpoint path).
+
+The subprocess tests (marker ``chaos``) SIGKILL/SIGTERM a real trainer
+process and assert exact resume — no sleeps-and-hope: every fault is
+armed deterministically through resilience.faults."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as ptpu
+from paddle_tpu import io as pio, layers
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.resilience import (RecoveryPolicy, ResilientTrainer,
+                                   StepWatchdog, faults,
+                                   resilient_reader)
+from paddle_tpu.trainer import EndIteration, Trainer
+
+
+@pytest.fixture(autouse=True)
+def _reset_resilience_flags():
+    yield
+    faults.disarm()
+    ptpu.config.set_flags(fault_injection=False, nonfinite_guard=False,
+                          nonfinite_policy="raise")
+
+
+def _counter(name):
+    fam = _metrics.REGISTRY.families().get(name)
+    return 0.0 if fam is None else fam.value
+
+
+def _build_regression():
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        h = layers.fc(x, 8, act="relu")
+        p = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(p, y))
+        ptpu.optimizer.SGD(learning_rate=0.05).minimize(
+            loss, startup_program=startup)
+    return main, startup, loss
+
+
+def _regression_reader(n, batch=16, seed=0):
+    def gen():
+        rs = np.random.RandomState(seed)
+        for _ in range(n):
+            xb = rs.randn(batch, 4).astype("float32")
+            yield {"x": xb,
+                   "y": (xb.sum(1, keepdims=True) * 0.5)
+                   .astype("float32")}
+    return gen
+
+
+# -- crash-safe checkpoint format ---------------------------------------
+
+
+def test_checkpoint_manifest_and_verify(tmp_path):
+    main, startup, loss = _build_regression()
+    exe = ptpu.Executor()
+    exe.run(startup)
+    pio.save_checkpoint(exe, str(tmp_path), 7, main)
+    cdir = tmp_path / "checkpoint_7"
+    manifest = json.loads((cdir / "manifest.json").read_text())
+    assert manifest["step"] == 7
+    assert "persistables.npz" in manifest["digests"]
+    assert all(len(d) == 64 for d in manifest["digests"].values())
+    ok, reason = pio.verify_checkpoint(str(cdir))
+    assert ok, reason
+    # no temp dirs left behind, latest.json valid JSON
+    assert not [d for d in os.listdir(tmp_path) if d.startswith("_tmp")]
+    assert pio.load_checkpoint_meta(str(tmp_path))["step"] == 7
+    # tamper -> verification names the bad file
+    with open(cdir / "persistables.npz", "r+b") as f:
+        f.truncate(64)
+    ok, reason = pio.verify_checkpoint(str(cdir))
+    assert not ok and "persistables.npz" in reason
+
+
+def test_load_falls_back_past_corrupt_checkpoint(tmp_path):
+    main, startup, loss = _build_regression()
+    exe = ptpu.Executor()
+    exe.run(startup)
+    tr = Trainer(loss, main_program=main, startup_program=startup,
+                 checkpoint_dir=str(tmp_path), checkpoint_every_n_steps=2)
+    tr.train(_regression_reader(6), num_passes=1, staging=False)
+    assert sorted(os.listdir(tmp_path))[:3] == [
+        "checkpoint_2", "checkpoint_4", "checkpoint_6"]
+    # truncate the newest: a torn write a non-atomic writer could leave
+    with open(tmp_path / "checkpoint_6" / "persistables.npz",
+              "r+b") as f:
+        f.truncate(64)
+    fallbacks0 = _counter("paddle_checkpoint_fallbacks_total")
+    quarantined0 = _counter("paddle_checkpoint_quarantined_total")
+    with ptpu.scope_guard(ptpu.Scope()):
+        step = pio.load_checkpoint(ptpu.Executor(), str(tmp_path), main)
+    assert step == 4  # newest INTACT, not the corrupt 6
+    assert _counter("paddle_checkpoint_fallbacks_total") == fallbacks0 + 1
+    assert _counter("paddle_checkpoint_quarantined_total") == \
+        quarantined0 + 1
+    # evidence preserved, not deleted
+    assert (tmp_path / "corrupt_checkpoint_6").is_dir()
+    # a fresh trainer resumes from the fallback step via startup()
+    t2 = Trainer(loss, main_program=main, startup_program=startup,
+                 checkpoint_dir=str(tmp_path))
+    with ptpu.scope_guard(ptpu.Scope()):
+        t2.startup()
+    assert t2.step_id == 4
+
+
+def test_load_survives_latest_pointing_at_pruned_dir(tmp_path):
+    """Satellite: latest.json referencing a deleted dir used to raise
+    FileNotFoundError; now the newest intact sibling loads."""
+    main, startup, loss = _build_regression()
+    exe = ptpu.Executor()
+    exe.run(startup)
+    pio.save_checkpoint(exe, str(tmp_path), 2, main, keep_last=0)
+    pio.save_checkpoint(exe, str(tmp_path), 4, main, keep_last=0)
+    import shutil
+    shutil.rmtree(tmp_path / "checkpoint_4")  # pruned behind our back
+    step = pio.load_checkpoint(exe, str(tmp_path), main)
+    assert step == 2
+    # nothing at all left -> None, still no crash
+    shutil.rmtree(tmp_path / "checkpoint_2")
+    assert pio.load_checkpoint(exe, str(tmp_path), main) is None
+    assert pio.load_checkpoint(exe, str(tmp_path / "nowhere"),
+                               main) is None
+
+
+def test_stale_latest_does_not_shadow_newer_intact_checkpoint(tmp_path):
+    """A crash between the atomic checkpoint publish and the latest.json
+    rewrite leaves latest one step behind; load must still pick the
+    newer intact dir (latest.json is a hint, not an override)."""
+    main, startup, loss = _build_regression()
+    exe = ptpu.Executor()
+    exe.run(startup)
+    pio.save_checkpoint(exe, str(tmp_path), 10, main)
+    pio.save_checkpoint(exe, str(tmp_path), 20, main)
+    # roll latest.json back to simulate the crash window
+    pio._write_json_atomic(
+        str(tmp_path / "latest.json"),
+        {"step": 10, "dir": str(tmp_path / "checkpoint_10")})
+    assert pio.load_checkpoint(exe, str(tmp_path), main) == 20
+
+
+def test_moved_checkpoint_tree_prefers_scanned_path(tmp_path):
+    """latest.json's stored absolute 'dir' goes stale when the tree is
+    moved; the scanned on-disk path for that step must win."""
+    import shutil
+    main, startup, loss = _build_regression()
+    exe = ptpu.Executor()
+    exe.run(startup)
+    old = tmp_path / "old"
+    pio.save_checkpoint(exe, str(old), 7, main)
+    pio.save_checkpoint(exe, str(old), 8, main)
+    new = tmp_path / "new"
+    shutil.move(str(old), str(new))  # latest.json now points into old/
+    assert pio.load_checkpoint(exe, str(new), main) == 8  # not 7
+
+
+def test_check_nan_inf_does_not_void_recovery_policy():
+    """The legacy assert-and-die flag raises inside the executor before
+    the policy runs; ResilientTrainer must supersede it."""
+    main, startup, loss = _build_regression()
+    ptpu.config.set_flags(check_nan_inf=True)
+    try:
+        faults.arm("nan_loss", at=2)
+        tr = ResilientTrainer(
+            loss, main_program=main, startup_program=startup,
+            policy=RecoveryPolicy(nonfinite_policy="skip",
+                                  nonfinite_budget=3))
+        assert not ptpu.config.get_flag("check_nan_inf")
+        steps = []
+        tr.train(_regression_reader(5), num_passes=1, staging=False,
+                 event_handler=lambda e: steps.append(e.step_id)
+                 if isinstance(e, EndIteration) else None)
+        assert len(steps) == 5  # skipped, not killed by the old flag
+    finally:
+        ptpu.config.set_flags(check_nan_inf=False)
+
+
+def test_quarantine_retention_is_bounded(tmp_path):
+    """corrupt_* dirs are evidence but bounded: saves prune all but the
+    newest two."""
+    main, startup, loss = _build_regression()
+    exe = ptpu.Executor()
+    exe.run(startup)
+    for i, name in enumerate(["corrupt_checkpoint_1",
+                              "corrupt_checkpoint_2",
+                              "corrupt_checkpoint_3",
+                              "corrupt_checkpoint_3.1"]):
+        d = tmp_path / name
+        d.mkdir(parents=True)
+        (d / "x").write_bytes(b"x")
+        os.utime(d, (1000 + i, 1000 + i))
+    pio.save_checkpoint(exe, str(tmp_path), 5, main)
+    left = sorted(d for d in os.listdir(tmp_path)
+                  if d.startswith("corrupt_"))
+    assert left == ["corrupt_checkpoint_3", "corrupt_checkpoint_3.1"]
+
+
+def test_preemption_during_startup_is_not_discarded(tmp_path):
+    """A stop requested while startup() loads the checkpoint (handlers
+    are installed before startup) must survive into the loop, not be
+    wiped by the stale-stop reset."""
+    main, startup, loss = _build_regression()
+    tr = Trainer(loss, main_program=main, startup_program=startup,
+                 checkpoint_dir=str(tmp_path))
+    orig_startup = tr.startup
+
+    def startup_with_signal():
+        orig_startup()
+        tr.request_stop("during_startup")  # as a SIGTERM handler would
+
+    tr.startup = startup_with_signal
+    steps = []
+    result = tr.train(_regression_reader(10), num_passes=1,
+                      staging=False,
+                      event_handler=lambda e: steps.append(e.step_id)
+                      if isinstance(e, EndIteration) else None)
+    assert result and result["preempted"]
+    assert result["reason"] == "during_startup"
+    assert len(steps) == 1  # the in-flight (first) step only
+
+
+def test_resilient_reader_retries_creation_failure():
+    """A transient failure in reader() CREATION (eager-open creators)
+    is retried, not just failures while iterating."""
+    state = {"fail": True}
+
+    def creator():
+        if state["fail"]:
+            state["fail"] = False
+            raise IOError("source briefly unavailable")
+        def gen():
+            yield from range(5)
+        return gen()
+
+    out = list(resilient_reader(lambda: creator(), backoff=0.001)())
+    assert out == list(range(5))
+
+
+def test_save_checkpoint_crash_leaves_previous_intact(tmp_path):
+    """In-process crash-during-write: the armed fault raises in the
+    window after data is written but before the atomic publish; the
+    half-written state stays invisible."""
+    main, startup, loss = _build_regression()
+    exe = ptpu.Executor()
+    exe.run(startup)
+    pio.save_checkpoint(exe, str(tmp_path), 2, main)
+    faults.arm("checkpoint_crash", at=4)
+    with pytest.raises(faults.InjectedFault):
+        pio.save_checkpoint(exe, str(tmp_path), 4, main)
+    assert not (tmp_path / "checkpoint_4").exists()
+    assert not [d for d in os.listdir(tmp_path) if d.startswith("_tmp")]
+    assert pio.load_checkpoint(exe, str(tmp_path), main) == 2
+
+
+# -- executor nonfinite guard -------------------------------------------
+
+
+def test_executor_nonfinite_guard_identity_update():
+    main, startup, loss = _build_regression()
+    exe = ptpu.Executor()
+    exe.run(startup)
+    scope = ptpu.global_scope()
+    params = [v.name for v in main.global_block().all_parameters()]
+    before = {n: np.asarray(scope.find_var(n)).copy() for n in params}
+    bad = {"x": np.full((16, 4), np.nan, "float32"),
+           "y": np.zeros((16, 1), "float32")}
+    ptpu.config.set_flags(nonfinite_guard=True)
+    out, = exe.run(main, feed=bad, fetch_list=[loss])
+    assert not np.isfinite(out).all()  # the NaN is still visible...
+    for n in params:  # ...but the donated update became identity
+        np.testing.assert_array_equal(np.asarray(scope.find_var(n)),
+                                      before[n])
+    # control: without the guard the same batch poisons the params
+    ptpu.config.set_flags(nonfinite_guard=False)
+    exe.run(main, feed=bad, fetch_list=[loss])
+    assert any(not np.isfinite(np.asarray(scope.find_var(n))).all()
+               for n in params)
+
+
+# -- non-finite step policies -------------------------------------------
+
+
+def test_nonfinite_skip_policy_converges_anyway():
+    """Acceptance: an injected NaN step triggers the skip policy and
+    smallnet training converges regardless."""
+    from paddle_tpu import dataset, reader as rd
+    from paddle_tpu.data_feeder import DataFeeder
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        img = layers.data("img", shape=[784])
+        label = layers.data("label", shape=[1], dtype="int64")
+        logits = layers.fc(layers.fc(img, 64, act="relu"), 10)
+        prob = layers.softmax(logits)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(prob, label)
+        ptpu.optimizer.Adam(learning_rate=1e-3).minimize(
+            loss, startup_program=startup)
+    faults.arm("nan_loss", at=5)
+    skipped0 = _counter("paddle_resilience_skipped_steps_total")
+    tr = ResilientTrainer(
+        loss, metrics={"acc": acc}, feeder=DataFeeder([img, label]),
+        main_program=main, startup_program=startup,
+        policy=RecoveryPolicy(nonfinite_policy="skip",
+                              nonfinite_budget=3))
+    events = {"last_acc": 0.0, "skipped": 0}
+
+    def handler(e):
+        if isinstance(e, EndIteration):
+            events["last_acc"] = e.metrics["acc"]
+            if e.metrics.get("skipped_nonfinite"):
+                events["skipped"] += 1
+
+    train_reader = rd.batch(rd.firstn(dataset.mnist.train(), 2048), 64)
+    tr.train(train_reader, num_passes=2, event_handler=handler)
+    assert events["skipped"] == 1
+    assert _counter("paddle_resilience_skipped_steps_total") == \
+        skipped0 + 1
+    assert events["last_acc"] > 0.8  # converged through the NaN step
+    scope = ptpu.global_scope()
+    for v in main.global_block().all_parameters():
+        assert np.isfinite(np.asarray(scope.find_var(v.name))).all()
+
+
+def test_nonfinite_rollback_policy_with_lr_backoff(tmp_path):
+    main, startup, loss = _build_regression()
+    faults.arm("nan_loss", at=5)
+    rollbacks0 = _counter("paddle_resilience_rollbacks_total")
+    tr = ResilientTrainer(
+        loss, main_program=main, startup_program=startup,
+        checkpoint_dir=str(tmp_path), checkpoint_every_n_steps=2,
+        policy=RecoveryPolicy(nonfinite_policy="rollback",
+                              nonfinite_budget=3, lr_backoff=0.5))
+    marks = []
+    tr.train(_regression_reader(8), num_passes=1, staging=False,
+             event_handler=lambda e: marks.append(
+                 e.metrics.get("rolled_back_to"))
+             if isinstance(e, EndIteration) else None)
+    assert [m for m in marks if m] == [4]  # rewound to last checkpoint
+    assert _counter("paddle_resilience_rollbacks_total") == rollbacks0 + 1
+    scope = ptpu.global_scope()
+    lr_vars = [n for n in main.global_block().vars
+               if n.startswith("learning_rate")]
+    assert lr_vars
+    for n in lr_vars:  # 0.05 * 0.5 backoff
+        np.testing.assert_allclose(np.asarray(scope.find_var(n)), 0.025)
+
+
+def test_nonfinite_budget_exhausted_raises():
+    main, startup, loss = _build_regression()
+    faults.arm("nan_loss", times=100)  # every step poisoned
+    tr = ResilientTrainer(
+        loss, main_program=main, startup_program=startup,
+        policy=RecoveryPolicy(nonfinite_policy="skip",
+                              nonfinite_budget=2))
+    with pytest.raises(FloatingPointError, match="budget exhausted"):
+        tr.train(_regression_reader(8), num_passes=1, staging=False)
+
+
+def test_nonfinite_budget_resets_on_finite_progress():
+    """The budget bounds CONSECUTIVE bad steps; isolated glitches over
+    a long job must not accumulate into a spurious abort."""
+    main, startup, loss = _build_regression()
+    faults.arm("nan_loss", at=1)
+    faults.arm("nan_loss", at=4)
+    tr = ResilientTrainer(
+        loss, main_program=main, startup_program=startup,
+        policy=RecoveryPolicy(nonfinite_policy="skip",
+                              nonfinite_budget=1))
+    tr.train(_regression_reader(8), num_passes=1, staging=False)
+    assert tr.nonfinite_seen <= 1  # each glitch was isolated
+
+
+def test_rollback_resyncs_lr_scheduler(tmp_path):
+    """restore_checkpoint rewinds step_id; the host-side scheduler
+    counter must follow or every LR after a rollback is scheduled for
+    the abandoned timeline's step count."""
+    from paddle_tpu import lr_scheduler
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        p = layers.fc(layers.fc(x, 8, act="relu"), 1)
+        loss = layers.mean(layers.square_error_cost(p, y))
+        opt = ptpu.optimizer.SGD(learning_rate=0.05)
+        opt.minimize(loss, startup_program=startup)
+    sched = lr_scheduler.ExponentialDecay(opt, decay_steps=10,
+                                          decay_rate=0.5)
+    faults.arm("nan_loss", at=5)
+    tr = ResilientTrainer(
+        loss, main_program=main, startup_program=startup,
+        checkpoint_dir=str(tmp_path), checkpoint_every_n_steps=4,
+        scheduler=sched,
+        policy=RecoveryPolicy(nonfinite_policy="rollback",
+                              nonfinite_budget=3))
+    tr.train(_regression_reader(8), num_passes=1, staging=False)
+    assert sched.step_num == tr.step_id  # timelines re-aligned
+
+
+def test_disarm_clears_master_switch():
+    faults.arm("unit_site2")
+    assert ptpu.config.get_flag("fault_injection")
+    faults.disarm()
+    assert not ptpu.config.get_flag("fault_injection")
+
+
+def test_nonfinite_default_policy_raises():
+    main, startup, loss = _build_regression()
+    faults.arm("nan_loss", at=2)
+    tr = ResilientTrainer(loss, main_program=main,
+                          startup_program=startup)
+    with pytest.raises(FloatingPointError, match="policy=raise"):
+        tr.train(_regression_reader(8), num_passes=1, staging=False)
+
+
+# -- reader retry -------------------------------------------------------
+
+
+def test_resilient_reader_absorbs_transient_failure():
+    state = {"fail": True}
+
+    def flaky():
+        for i in range(10):
+            if i == 4 and state["fail"]:
+                state["fail"] = False
+                raise IOError("transient")
+            yield i
+
+    retries0 = _counter("paddle_resilience_reader_retries_total")
+    out = list(resilient_reader(lambda: flaky(), backoff=0.001)())
+    assert out == list(range(10))  # no loss, no duplicates
+    assert _counter("paddle_resilience_reader_retries_total") == \
+        retries0 + 1
+
+
+def test_resilient_reader_permanent_failure_propagates():
+    def dead():
+        yield 0
+        raise IOError("permanent")
+
+    with pytest.raises(IOError, match="permanent"):
+        list(resilient_reader(lambda: dead(), retries=2,
+                              backoff=0.001)())
+
+
+def test_reader_fault_injection_through_trainer():
+    """Acceptance-path: an armed reader IOError at batch K no longer
+    kills the pass — the retry wrapper absorbs it."""
+    main, startup, loss = _build_regression()
+    faults.arm("reader_error", at=3, exc=IOError("injected"))
+    tr = ResilientTrainer(
+        loss, main_program=main, startup_program=startup,
+        policy=RecoveryPolicy(nonfinite_policy="skip",
+                              reader_backoff=0.001))
+    steps = []
+    tr.train(_regression_reader(6), num_passes=1, staging=False,
+             event_handler=lambda e: steps.append(e.step_id)
+             if isinstance(e, EndIteration) else None)
+    assert len(steps) == 6  # all batches trained despite the fault
+
+
+def test_reader_fault_default_exception_is_transient():
+    """An exc-less arm("reader_error") must raise something inside the
+    resilient reader's transient set (IOError), not InjectedFault —
+    else the documented chaos hook would kill the pass it exercises."""
+    main, startup, loss = _build_regression()
+    faults.arm("reader_error", at=2)  # no exc= on purpose
+    tr = ResilientTrainer(
+        loss, main_program=main, startup_program=startup,
+        policy=RecoveryPolicy(nonfinite_policy="skip",
+                              reader_backoff=0.001))
+    steps = []
+    tr.train(_regression_reader(5), num_passes=1, staging=False,
+             event_handler=lambda e: steps.append(e.step_id)
+             if isinstance(e, EndIteration) else None)
+    assert len(steps) == 5
+
+
+def test_lr_backoff_compounds_across_rollbacks(tmp_path):
+    """Consecutive rollbacks restore the checkpointed (pre-backoff) LR
+    var; the backoff must apply to the LIVE rate so it compounds
+    (0.05 -> 0.025 -> 0.0125) instead of flooring at ckpt_lr*factor."""
+    main, startup, loss = _build_regression()
+    # step_id 5 is hit twice: once on first contact, again after the
+    # first rollback rewinds to the step-4 checkpoint
+    faults.arm("nan_loss", at=5, times=2)
+    rollbacks0 = _counter("paddle_resilience_rollbacks_total")
+    tr = ResilientTrainer(
+        loss, main_program=main, startup_program=startup,
+        checkpoint_dir=str(tmp_path), checkpoint_every_n_steps=4,
+        policy=RecoveryPolicy(nonfinite_policy="rollback",
+                              nonfinite_budget=5, lr_backoff=0.5))
+    tr.train(_regression_reader(10), num_passes=1, staging=False)
+    assert _counter("paddle_resilience_rollbacks_total") == \
+        rollbacks0 + 2
+    scope = ptpu.global_scope()
+    for n in main.global_block().vars:
+        if n.startswith("learning_rate"):
+            np.testing.assert_allclose(
+                np.asarray(scope.find_var(n)), 0.05 * 0.5 * 0.5)
+
+
+def test_save_sweeps_stale_tmp_dirs_from_dead_writers(tmp_path):
+    """A writer SIGKILLed mid-save leaves _tmp_checkpoint_<step>.<pid>;
+    the next save (any pid) must sweep it or every crash leaks a
+    full-size copy of the model state."""
+    main, startup, loss = _build_regression()
+    exe = ptpu.Executor()
+    exe.run(startup)
+    stale = tmp_path / "_tmp_checkpoint_9.99999"
+    stale.mkdir(parents=True)
+    (stale / "persistables.npz").write_bytes(b"x" * 128)
+    pio.save_checkpoint(exe, str(tmp_path), 2, main)
+    assert not [d for d in os.listdir(tmp_path) if d.startswith("_tmp")]
+
+
+# -- watchdog -----------------------------------------------------------
+
+
+def test_watchdog_fires_once_per_overrun_step():
+    stalls0 = _counter("paddle_resilience_watchdog_stalls_total")
+    wd = StepWatchdog(0.05, poll_interval=0.01).start()
+    try:
+        wd.step_started(1)
+        time.sleep(0.25)
+        assert _counter("paddle_resilience_watchdog_stalls_total") == \
+            stalls0 + 1  # once, not once-per-poll
+        wd.step_finished()
+        wd.step_started(2)
+        wd.step_finished()  # fast step: no firing
+        time.sleep(0.1)
+        assert _counter("paddle_resilience_watchdog_stalls_total") == \
+            stalls0 + 1
+    finally:
+        wd.stop()
+
+
+def test_watchdog_abort_interrupts_main_thread():
+    wd = StepWatchdog(0.05, abort=True, poll_interval=0.01).start()
+    try:
+        wd.step_started(1)
+        with pytest.raises(KeyboardInterrupt):
+            time.sleep(5)  # the watchdog unblocks this long before 5s
+    finally:
+        wd.stop()
+
+
+def test_watchdog_abort_leaves_sigint_on_default_handler():
+    """interrupt_main() is delivered as SIGINT; if the preemption guard
+    owned SIGINT while abort is armed, the abort would degrade to a
+    stop-flag a hung step never checks."""
+    main, startup, loss = _build_regression()
+    observed = {}
+
+    def handler(e):
+        if isinstance(e, EndIteration) and e.step_id == 1:
+            observed["sigint"] = signal.getsignal(signal.SIGINT)
+            observed["sigterm"] = signal.getsignal(signal.SIGTERM)
+
+    tr = ResilientTrainer(
+        loss, main_program=main, startup_program=startup,
+        policy=RecoveryPolicy(step_deadline_sec=60,
+                              watchdog_abort=True))
+    tr.train(_regression_reader(3), num_passes=1, staging=False,
+             event_handler=handler)
+    assert observed["sigint"] is signal.default_int_handler
+    assert callable(observed["sigterm"]) and \
+        observed["sigterm"] is not signal.SIG_DFL  # guard still owns it
+
+
+# -- fault registry determinism -----------------------------------------
+
+
+def test_fault_registry_arm_fire_disarm():
+    faults.arm("unit_site", at=3, times=1)
+    assert ptpu.config.get_flag("fault_injection")
+    assert faults.should_fire("unit_site", 2) is None
+    assert faults.should_fire("unit_site", 3) is not None
+    assert faults.should_fire("unit_site", 3) is None  # consumed
+    faults.arm("unit_site", action="callback", callback=lambda: None)
+    assert faults.fire_point("unit_site", 0) is not None  # callback ran
+    faults.disarm("unit_site")
+    assert faults.should_fire("unit_site", 3) is None
+    ptpu.config.set_flags(fault_injection=False)
+    faults.arm("unit_site")  # arming re-enables the master switch
+    assert ptpu.config.get_flag("fault_injection")
+
+
+# -- preemption ---------------------------------------------------------
+
+
+def test_preemption_signal_checkpoints_and_resumes_exactly(tmp_path):
+    """In-process SIGTERM (deterministic: raised from the event handler
+    via os.kill, delivered before the next step): the in-flight step
+    finishes, the final checkpoint carries resume metadata, and a new
+    trainer resumes at the exact interrupted step."""
+    main, startup, loss = _build_regression()
+    preempt0 = _counter("paddle_resilience_preemptions_total")
+    tr = ResilientTrainer(loss, main_program=main,
+                          startup_program=startup,
+                          checkpoint_dir=str(tmp_path),
+                          checkpoint_every_n_steps=100)
+    seen = []
+
+    def handler(e):
+        if isinstance(e, EndIteration):
+            seen.append(e.step_id)
+            if e.step_id == 3:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    result = tr.train(_regression_reader(20), num_passes=1,
+                      staging=False, event_handler=handler)
+    assert result and result["preempted"]
+    # the signal landed during step 3's EndIteration — that step is the
+    # in-flight one and it completed; nothing after it ran
+    assert result["step"] == 3
+    assert seen[-1] == 3
+    assert _counter("paddle_resilience_preemptions_total") == \
+        preempt0 + 1
+    meta = pio.load_checkpoint_meta(str(tmp_path))
+    assert meta["preempted"] and meta["step"] == 3
+    assert meta["reason"] == "signal_%d" % signal.SIGTERM
+    t2 = Trainer(loss, main_program=main, startup_program=startup,
+                 checkpoint_dir=str(tmp_path))
+    with ptpu.scope_guard(ptpu.Scope()):
+        t2.startup()
+    assert t2.step_id == 3  # exact resume
+
+
+# -- subprocess chaos ---------------------------------------------------
+
+
+def _child_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+_CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "chaos_child.py")
+
+
+@pytest.mark.chaos
+def test_sigkill_during_checkpoint_write_resumes_from_intact(tmp_path):
+    """Acceptance: a SIGKILL during checkpoint write never leaves
+    load_checkpoint loading a corrupt state — the process self-kills in
+    the written-but-unpublished window (deterministic fault), and the
+    restart resumes from the previous intact checkpoint."""
+    ckpt = str(tmp_path / "ckpt")
+    p = subprocess.run(
+        [sys.executable, _CHILD, "train-kill", ckpt, "6"],
+        capture_output=True, text=True, env=_child_env(), timeout=240)
+    assert p.returncode == -signal.SIGKILL, \
+        "child should die by its own SIGKILL:\n%s%s" % (p.stdout,
+                                                       p.stderr)
+    # step-6 checkpoint died unpublished; 2 and 4 are intact
+    dirs = sorted(d for d in os.listdir(ckpt)
+                  if d.startswith("checkpoint"))
+    assert dirs == ["checkpoint_2", "checkpoint_4"]
+    r = subprocess.run(
+        [sys.executable, _CHILD, "resume", ckpt],
+        capture_output=True, text=True, env=_child_env(), timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "RESUMED_STEP 4" in r.stdout, r.stdout
+
+
+@pytest.mark.chaos
+def test_sigterm_preemption_across_processes(tmp_path):
+    """Acceptance: SIGTERM preemption produces a checkpoint that a NEW
+    PROCESS resumes at the exact interrupted step."""
+    ckpt = str(tmp_path / "ckpt")
+    p = subprocess.Popen(
+        [sys.executable, _CHILD, "train-preempt", ckpt],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=_child_env(), text=True)
+    try:
+        lines = []
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            line = p.stdout.readline()
+            if not line:
+                break
+            lines.append(line.strip())
+            if line.startswith("STEP ") and \
+                    int(line.split()[1]) >= 3:
+                p.send_signal(signal.SIGTERM)
+                break
+        out, _ = p.communicate(timeout=120)
+        lines += out.strip().splitlines()
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.communicate()
+    assert p.returncode == 0, "\n".join(lines)
+    preempted = [ln for ln in lines if ln.startswith("PREEMPTED ")]
+    assert preempted, "\n".join(lines)
+    resume_meta = json.loads(preempted[0].split(" ", 1)[1])
+    assert resume_meta["preempted"]
+    r = subprocess.run(
+        [sys.executable, _CHILD, "resume", ckpt],
+        capture_output=True, text=True, env=_child_env(), timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "RESUMED_STEP %d" % resume_meta["step"] in r.stdout, \
+        (r.stdout, resume_meta)
+    meta_line = [ln for ln in r.stdout.splitlines()
+                 if ln.startswith("META ")][0]
+    meta = json.loads(meta_line.split(" ", 1)[1])
+    assert meta["preempted"] and meta["step"] == resume_meta["step"]
+
+
+@pytest.mark.chaos
+def test_master_killed_mid_pass_recovers_from_snapshot(tmp_path):
+    """Fault site ``master_kill``: the task master dies mid-pass (armed
+    callback kills it after 2 leases) and a restart on the same port
+    recovers the queue from its disk snapshot; the worker's client
+    retries through the outage and the pass completes with full sample
+    coverage (at-least-once, as in the reference)."""
+    from paddle_tpu.distributed import (ElasticDataDispatcher,
+                                        MasterClient, MasterServer)
+    from paddle_tpu.reader import recordio as rio
+
+    path = str(tmp_path / "ds.rec")
+    rio.write_recordio(path, list(range(200)), max_chunk_bytes=128)
+    snap = str(tmp_path / "snap")
+    servers = [MasterServer(snap, timeout_sec=30)]
+    port = servers[0].port
+
+    def kill_and_restart():
+        servers[-1].kill()
+        servers.append(MasterServer(snap, port=port, timeout_sec=30))
+
+    try:
+        c = MasterClient(port)
+        disp = ElasticDataDispatcher(c, path, "w0")
+        n_chunks = disp.register_dataset()
+        assert n_chunks > 2
+        faults.arm("master_kill", at=2, action="callback",
+                   callback=kill_and_restart)
+        got = list(disp.reader()())
+        assert len(servers) == 2  # the fault really fired
+        # at-least-once across the failover: nothing lost
+        assert set(got) == set(range(200))
+        assert MasterClient(port).stats()["done"] >= n_chunks
+    finally:
+        for s in servers:
+            s.stop(graceful=False)
+
+
+# -- master client fd hygiene (satellite) -------------------------------
+
+
+class _FakeSock:
+    def __init__(self, fail=True):
+        self.closed = False
+        self.fail = fail
+        self.file = None
+
+    def sendall(self, data):
+        if self.fail:
+            raise OSError("connection reset")
+
+    def makefile(self, mode):
+        self.file = _FakeFile()
+        return self.file
+
+    def close(self):
+        self.closed = True
+
+
+class _FakeFile:
+    def __init__(self):
+        self.closed = False
+
+    def readline(self):
+        return "PONG\n"
+
+    def close(self):
+        self.closed = True
+
+
+def test_master_client_closes_socket_and_file_on_failure():
+    from paddle_tpu.distributed.master import MasterClient
+    c = MasterClient(0, retries=2)
+    made = []
+
+    def fake_connect():
+        s = _FakeSock(fail=True)
+        c._sock = s
+        c._file = s.makefile("r")
+        made.append(s)
+
+    c._connect = fake_connect
+    with pytest.raises(ConnectionError):
+        c._call("PING")
+    assert len(made) == 2  # one socket per retry
+    for s in made:  # the leak fix: BOTH fds closed every time
+        assert s.closed and s.file.closed
+    assert c._sock is None and c._file is None
+
+
+def test_master_client_close_then_reuse():
+    from paddle_tpu.distributed.master import MasterClient
+    c = MasterClient(0, retries=1)
+    sequence = [_FakeSock(fail=True), _FakeSock(fail=False)]
+
+    def fake_connect():
+        s = sequence.pop(0)
+        c._sock = s
+        c._file = s.makefile("r")
+
+    c.retries = 2
+    c._connect = fake_connect
+    assert c._call("PING") == "PONG"  # retried onto the good socket
+    assert sequence == []

@@ -1,0 +1,144 @@
+"""Chaos smoke probe: the whole recovery pipeline, headless.
+
+Trains a smallnet on CPU while resilience.faults deterministically
+injects the three canonical unhappy paths —
+
+1. a NaN loss at step 3 (skip policy neutralizes it),
+2. a reader IOError at batch 6 (retry-with-backoff absorbs it),
+3. a crash during checkpoint write at step 8 (the atomic publish makes
+   the half-written state invisible; a restarted trainer digest-
+   verifies and resumes from the last intact checkpoint),
+
+then prints the recovery counters from the metrics registry and exits
+non-zero unless every recovery actually happened. This is the first
+thing to run when touching the resilience layer (see README
+"Resilience" and PROFILE.md).
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/chaos_probe.py
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build():
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[16])
+        y = layers.data("y", shape=[1])
+        h = layers.fc(x, 32, act="relu")
+        p = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(p, y))
+        ptpu.optimizer.Momentum(learning_rate=0.05, momentum=0.9) \
+            .minimize(loss, startup_program=startup)
+    return main, startup, loss
+
+
+def reader(n):
+    def gen():
+        for i in range(n):
+            rs = np.random.RandomState(i)
+            xb = rs.randn(16, 16).astype("float32")
+            yield {"x": xb,
+                   "y": (xb.sum(1, keepdims=True) * 0.25)
+                   .astype("float32")}
+    return gen
+
+
+def main():
+    import tempfile
+
+    import paddle_tpu as ptpu
+    from paddle_tpu import io as pio
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.resilience import (RecoveryPolicy, ResilientTrainer,
+                                       faults)
+
+    ckpt_dir = tempfile.mkdtemp(prefix="chaos_probe_ckpt_")
+    policy = RecoveryPolicy(nonfinite_policy="skip", nonfinite_budget=3,
+                            reader_backoff=0.01)
+
+    # -- arm the chaos (deterministic: step/batch indices, no sleeps) ----
+    faults.arm("nan_loss", at=3)
+    faults.arm("reader_error", at=6, exc=IOError("injected reader fault"))
+    faults.arm("checkpoint_crash", at=8)
+
+    losses = []
+    main_prog, startup, loss = build()
+    tr = ResilientTrainer(loss, main_program=main_prog,
+                          startup_program=startup,
+                          checkpoint_dir=ckpt_dir,
+                          checkpoint_every_n_steps=4, policy=policy)
+
+    crashed = False
+    try:
+        tr.train(reader(12), num_passes=1, staging=False,
+                 event_handler=lambda e: losses.append(
+                     e.metrics["loss"]) if hasattr(e, "step_id")
+                 else None)
+    except faults.InjectedFault:
+        crashed = True  # the checkpoint-write crash at step 8
+
+    # restart: digest-verified load falls back to the intact step-4 dir
+    tr2 = ResilientTrainer(loss, main_program=main_prog,
+                           startup_program=startup,
+                           checkpoint_dir=ckpt_dir, policy=policy)
+    with ptpu.scope_guard(ptpu.Scope()):
+        tr2.startup()
+        resumed_at = tr2.step_id
+
+    # -- report ----------------------------------------------------------
+    dump = metrics.REGISTRY.dump()
+    names = [
+        "paddle_resilience_nonfinite_steps_total",
+        "paddle_resilience_skipped_steps_total",
+        "paddle_resilience_reader_retries_total",
+        "paddle_checkpoint_fallbacks_total",
+        "paddle_checkpoint_quarantined_total",
+        "paddle_resilience_rollbacks_total",
+        "paddle_resilience_watchdog_stalls_total",
+        "paddle_resilience_preemptions_total",
+    ]
+    print("== recovery counters " + "=" * 45)
+    counters = {}
+    for n in names:
+        samples = dump.get(n, {}).get("samples", [])
+        counters[n] = samples[0]["value"] if samples else 0.0
+        print("%-48s %g" % (n, counters[n]))
+    print("== summary " + "=" * 55)
+    print(json.dumps({
+        "steps_trained": len(losses),
+        "final_loss": float(np.asarray(losses[-1])) if losses else None,
+        "checkpoint_crash_seen": crashed,
+        "resumed_at_step": resumed_at,
+        "checkpoint_dirs": sorted(
+            d for d in os.listdir(ckpt_dir) if "checkpoint" in d),
+    }, indent=1, sort_keys=True))
+
+    # -- smoke assertions (exit non-zero if recovery is broken) ----------
+    assert counters["paddle_resilience_skipped_steps_total"] >= 1, \
+        "NaN step was not skipped"
+    assert counters["paddle_resilience_reader_retries_total"] >= 1, \
+        "reader fault was not retried"
+    assert crashed, "checkpoint_crash fault never fired"
+    assert resumed_at == 4, \
+        "expected resume from intact checkpoint_4, got %r" % resumed_at
+    assert losses and np.isfinite(np.asarray(losses[-1])), \
+        "training did not stay finite"
+    faults.disarm()
+    print("CHAOS_PROBE_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
