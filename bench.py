@@ -253,7 +253,13 @@ def bench_transformer_lm(on_accel, peak):
     d, L, H = (2048, 12, 16) if on_accel else (64, 2, 2)
     T = 1024 if on_accel else 32
     B = 8 if on_accel else 2
-    steps = 10 if on_accel else 2
+    # Round 8 stabilization (same discipline as the r5 pipeline bench):
+    # the r04->r05 swing (376.5 -> 409.4 ms/step) was indistinguishable
+    # from rig drift because the number came from ONE timed window.
+    # Now: warmup, then median over several independently-synced
+    # windows, with the window spread reported as a drift field.
+    windows = 5 if on_accel else 3
+    steps = 4 if on_accel else 2  # per window
     warmup = 2 if on_accel else 1
 
     main_prog, startup = ptpu.Program(), ptpu.Program()
@@ -279,13 +285,16 @@ def bench_transformer_lm(on_accel, peak):
         outs = exe.run(main_prog, feed=feed, fetch_list=[loss],
                        return_numpy=False)
     np.asarray(outs[0])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        outs = exe.run(main_prog, feed=feed, fetch_list=[loss],
-                       return_numpy=False)
-    final_loss = float(np.asarray(outs[0]))
-    dt = (time.perf_counter() - t0) / steps
-    tok_per_sec = B * T / dt
+    window_ms = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            outs = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                           return_numpy=False)
+        final_loss = float(np.asarray(outs[0]))  # sync closes the window
+        window_ms.append((time.perf_counter() - t0) / steps * 1e3)
+    dt_ms = float(np.median(window_ms))
+    tok_per_sec = B * T / (dt_ms / 1e3)
 
     out = {
         "metric": "transformer_lm_train_tokens_per_sec" if on_accel
@@ -294,7 +303,10 @@ def bench_transformer_lm(on_accel, peak):
         "unit": "tokens/sec",
         "vs_baseline": round(tok_per_sec / 34783.0, 3),  # RNN proxy
         "loss": round(final_loss, 4),
-        "ms_per_step": round(dt * 1e3, 1),
+        "ms_per_step": round(dt_ms, 1),
+        "ms_per_step_drift": [round(min(window_ms), 1),
+                              round(max(window_ms), 1)],
+        "windows": windows,
         "n_params": n_params,
     }
     if on_accel and peak:
@@ -306,29 +318,25 @@ def bench_transformer_lm(on_accel, peak):
 
 
 def bench_resnet_pipeline(on_accel):
-    """ResNet through Trainer.train + the arena-staged input pipeline
-    (reader/staging.py), vs the compute-only path. On real TPU hosts
-    H2D runs at GB/s and the staged pipeline holds the compute rate;
-    this rig's tunneled device moves ~15 MB/s host->device, so the
-    honest metric here is OVERLAP EFFICIENCY: steady-state step time
-    vs max(compute, feed) — 1.0 means staging fully hides whichever
-    side is cheaper (the async double-buffer property, reference
-    DataProvider.h:375).
+    """ResNet through Trainer.train + the narrow-wire staged pipeline
+    (reader/staging.py + core/ingest.py), vs the compute-only path.
+    Round 8: the feed crosses the wire in WIRE form — uint8 images and
+    int32 labels packed into one contiguous arena block, ONE device_put
+    per batch — and the executor widens/normalizes on device inside the
+    compiled step. That's ~4x fewer bytes than the r05 f32/int64 feed
+    and N->1 transfer dispatches; both are reported (and the dispatch
+    count asserted) via the staging wire counters.
 
-    Round 5 robustness (VERDICT r4 weak #1 — the 0.57 capture): the
-    tunnel's H2D rate drifts ~2x within minutes (tools/pipeline_probe.py:
-    262-460 ms for the same 4.8 MB batch), so the H2D reference is now
-    measured in-window — bracketing reps immediately before AND after
-    the timed pass, combined by median — and the drift is reported.
-    The probe's breakdown of the r4 step: staging assembly 6 ms +
-    device_put dispatch 18 ms per batch; the rest of the 433 ms step
-    WAS the transfer at that window's tunnel rate — there was no lost
-    time, the two windows just saw different rates (PROFILE.md r5)."""
+    The honest metric on this tunneled rig stays OVERLAP EFFICIENCY
+    (steady-state step time vs max(compute, wire-H2D)); the H2D
+    reference is bracketed before/after the pass and combined by median
+    (round-5 drift discipline)."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as ptpu
     from paddle_tpu import layers
     from paddle_tpu.models import resnet
+    from paddle_tpu.reader import staging as _staging
     from paddle_tpu.trainer import Trainer
 
     batch = 8 if on_accel else 4
@@ -338,8 +346,10 @@ def bench_resnet_pipeline(on_accel):
 
     main_prog, startup = ptpu.Program(), ptpu.Program()
     with ptpu.program_guard(main_prog, startup):
-        img = layers.data("img", shape=[3, res, res])
-        label = layers.data("label", shape=[1], dtype="int64")
+        img = layers.data("img", shape=[3, res, res],
+                          wire_dtype="uint8", scale=1.0 / 255.0)
+        label = layers.data("label", shape=[1], dtype="int64",
+                            wire_dtype="int32")
         if on_accel:
             loss, acc, _ = resnet.resnet_imagenet(img, label,
                                                   depth=depth)
@@ -351,16 +361,21 @@ def bench_resnet_pipeline(on_accel):
 
     rs = np.random.RandomState(0)
     host_batches = [
-        {"img": rs.randn(batch, 3, res, res).astype("float32"),
-         "label": rs.randint(0, 1000, (batch, 1)).astype("int64")}
+        {"img": rs.randint(0, 256, (batch, 3, res, res), "int64")
+            .astype("uint8"),
+         "label": rs.randint(0, 1000, (batch, 1)).astype("int32")}
         for _ in range(3)]
 
-    # compute-only reference: batch resident in HBM, async chain
+    # compute-only reference: widened batch resident in HBM (the model
+    # sees the same values the ingest prologue produces), async chain
     tr = Trainer(loss, main_program=main_prog,
                  startup_program=startup, async_metrics=True)
     tr.startup()
-    dev_feed = {k: jax.device_put(jnp.asarray(v))
-                for k, v in host_batches[0].items()}
+    dev_feed = {
+        "img": jax.device_put(
+            jnp.asarray(host_batches[0]["img"], jnp.float32)
+            * np.float32(1.0 / 255.0)),
+        "label": jax.device_put(jnp.asarray(host_batches[0]["label"]))}
     m = tr._train_feed(dev_feed)
     np.asarray(m["loss"])
     t0 = time.perf_counter()
@@ -369,7 +384,7 @@ def bench_resnet_pipeline(on_accel):
     np.asarray(m["loss"])
     compute_ms = (time.perf_counter() - t0) / steps * 1e3
 
-    nbytes = sum(v.nbytes for v in host_batches[0].values())
+    wire_nbytes = sum(v.nbytes for v in host_batches[0].values())
 
     def h2d_reps(n):
         times = []
@@ -387,13 +402,34 @@ def bench_resnet_pipeline(on_accel):
         for i in range(steps):
             yield dict(host_batches[i % len(host_batches)])
 
+    prev_flags = {"packed_feeds": ptpu.config.get_flag("packed_feeds"),
+                  "telemetry": ptpu.config.get_flag("telemetry")}
+    ptpu.config.set_flags(packed_feeds=True, telemetry=True)
     metrics = []
-    t0 = time.perf_counter()
-    tr.train(reader, num_passes=1,
-             event_handler=lambda e: metrics.append(e.metrics["loss"])
-             if hasattr(e, "metrics") and hasattr(e, "step_id") else None)
-    np.asarray(metrics[-1])
-    pipeline_ms = (time.perf_counter() - t0) / steps * 1e3
+    try:
+        # warm the packed-feed compile-cache entry (uint8 feed signature
+        # != the f32 reference entry) OUTSIDE the timed window, like the
+        # compute reference warms its own
+        tr.train(lambda: iter([dict(host_batches[0])]), num_passes=1)
+        c0 = (_staging._TRANSFERS.value, _staging._WIRE_BYTES.value,
+              _staging._LEGACY_BYTES.value)
+        t0 = time.perf_counter()
+        tr.train(reader, num_passes=1,
+                 event_handler=lambda e: metrics.append(e.metrics["loss"])
+                 if hasattr(e, "metrics") and hasattr(e, "step_id")
+                 else None)
+        np.asarray(metrics[-1])
+        pipeline_ms = (time.perf_counter() - t0) / steps * 1e3
+        transfers = _staging._TRANSFERS.value - c0[0]
+        wire_bytes = _staging._WIRE_BYTES.value - c0[1]
+        legacy_bytes = _staging._LEGACY_BYTES.value - c0[2]
+    finally:
+        ptpu.config.set_flags(**prev_flags)
+    # the fused single-copy contract: one H2D dispatch per batch
+    if transfers != steps:
+        raise RuntimeError(
+            "packed feed path issued %d H2D dispatches over %d batches "
+            "(want exactly 1 per batch)" % (transfers, steps))
 
     h2d_samples += h2d_reps(4)  # bracket: after
     h2d_ms = float(np.median(h2d_samples))
@@ -415,7 +451,11 @@ def bench_resnet_pipeline(on_accel):
         "h2d_ms_per_batch": round(h2d_ms, 1),
         "h2d_drift_ms": [round(min(h2d_samples), 1),
                          round(max(h2d_samples), 1)],
-        "h2d_gbps": round(nbytes / (h2d_ms / 1e3) / 1e9, 3),
+        "h2d_gbps": round(wire_nbytes / (h2d_ms / 1e3) / 1e9, 3),
+        "h2d_dispatches_per_batch": transfers // steps,
+        "wire_bytes_per_batch": wire_bytes // steps,
+        "legacy_bytes_per_batch": legacy_bytes // steps,
+        "wire_cut": round(legacy_bytes / max(wire_bytes, 1), 2),
         "batch": batch,
     }
 
@@ -500,8 +540,35 @@ def _isolated(fn):
     return out
 
 
+def main_multichip(n_devices):
+    """Multi-chip dry run with a guaranteed tail: dryrun_multichip
+    ALWAYS prints exactly one JSON line (its success metric, or an
+    explicit skipped line with the reason before re-raising —
+    MULTICHIP_r05.json had ok=true with an EMPTY tail because nothing
+    on the success path printed). This entry point just maps the
+    outcome to an exit code; if even the import fails, print the
+    skipped line here."""
+    try:
+        import __graft_entry__ as _entry
+    except BaseException as e:  # noqa: BLE001 — the line must print
+        msg = "%s: %s" % (type(e).__name__, e)
+        print(json.dumps({"metric": "multichip_dryrun",
+                          "skipped": True, "reason": msg[:300]}),
+              flush=True)
+        return 1
+    try:
+        _entry.dryrun_multichip(n_devices)
+        return 0
+    except BaseException:  # noqa: BLE001 — skipped line already printed
+        return 1
+
+
 def main():
     import paddle_tpu as ptpu
+
+    if len(sys.argv) >= 2 and sys.argv[1] == "--multichip":
+        n = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+        return main_multichip(n)
 
     on_accel, peak = _device_info()
     if on_accel:

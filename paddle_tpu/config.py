@@ -29,6 +29,16 @@ serving_buckets: default batch buckets for serving.ServingEngine —
   the executor's compile cache sees a closed set of shapes (engines
   constructed with explicit ``buckets=`` ignore this).
 
+packed_feeds: if True, reader/staging.py packs every batch's feed
+  arrays into ONE contiguous 64B-aligned arena block and issues ONE
+  ``jax.device_put`` per batch (one per mesh shard under data
+  parallelism — jax.make_array_from_single_device_arrays, never a
+  replicated full-batch transfer). The executor unpacks inside the
+  compiled step (static slices + bitcasts, core/ingest.py) and donates
+  the consumed buffer. Off (default): the legacy one-device_put-per-
+  array staging path, byte-identical behavior. Independent of
+  wire_dtype declarations (layers.data), which are opt-in per feed.
+
 telemetry: if True, arm the observability layer (observability/):
   executor compile-cache + cost-analysis metrics, trainer step-latency/
   throughput metrics, staging queue/arena gauges, and host trace spans
@@ -72,6 +82,7 @@ _flags = {
     # Pallas fused attention kernel for multihead_attention (see
     # ops/pallas_attention.py); interpret-mode off-TPU
     "flash_attention": False,
+    "packed_feeds": False,
     "telemetry": False,
     "serving_buckets": (1, 8, 32),
     # resilience (resilience/supervisor.py defaults; see docstring)

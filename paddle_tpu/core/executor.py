@@ -27,6 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import ingest as _ingest
 from . import registry
 from .framework import (Program, Variable, default_main_program,
                         convert_dtype, RNG_STATE_VAR)
@@ -69,6 +70,37 @@ _STEP_BYTES = _metrics.REGISTRY.gauge(
 # Global key_id source: labels must not alias across Executors or
 # threads (itertools.count.__next__ is atomic under the GIL).
 _KEY_IDS = itertools.count(1)
+
+
+def _dtype_str(dt):
+    return "bfloat16" if dt is jnp.bfloat16 else np.dtype(dt).name
+
+
+def _ingest_spec(var, arriving_dtype, name, packed=False):
+    """The prologue step (name, target_dtype, scale, mean, std) for one
+    feed arriving as ``arriving_dtype``, or None when the feed needs no
+    on-device work. Normalize attrs fire ONLY for wire-form arrivals:
+    an already-widened (host-normalized) feed is the legacy path and
+    must stay byte-identical."""
+    if var is None:
+        return None
+    target = convert_dtype(var.dtype)
+    wire = getattr(var, "wire_dtype", None)
+    try:
+        arriving = np.dtype(arriving_dtype)
+    except TypeError:
+        arriving = arriving_dtype  # bf16 scalar type
+    if wire is not None and arriving == np.dtype(wire):
+        norm = getattr(var, "ingest", None) or {}
+        return (name, _dtype_str(target),
+                _ingest.canon_norm(norm.get("scale")),
+                _ingest.canon_norm(norm.get("mean")),
+                _ingest.canon_norm(norm.get("std")))
+    if packed and arriving != np.dtype(target):
+        # packed feeds skip the host-side asarray cast, so any residual
+        # dtype gap is closed on device instead
+        return (name, _dtype_str(target), None, None, None)
+    return None
 
 
 class _CacheEntry:
@@ -315,7 +347,7 @@ class Executor:
         if not isinstance(program, Program):
             raise TypeError("Executor.run expects a Program, got %r"
                             % (program,))
-        feed = feed or {}
+        feed = {} if feed is None else feed
         fetch_list = fetch_list or []
         scope = scope or global_scope()
         block = program.global_block()
@@ -323,13 +355,47 @@ class Executor:
         fetch_names = [v.name if isinstance(v, Variable) else v
                        for v in fetch_list]
 
-        # Normalize feeds to arrays with var dtype.
-        feed_arrays = {}
-        for name, value in feed.items():
-            var = block.var_or_none(name)
-            dtype = convert_dtype(var.dtype) if var is not None else None
-            arr = jnp.asarray(value, dtype=dtype)
-            feed_arrays[name] = arr
+        # Normalize feeds. Three shapes of arrival:
+        # * PackedBatch — the whole batch is ONE uint8 buffer; the step
+        #   unpacks it (static slices + bitcasts) and the buffer is
+        #   donated. Per-slot widening goes through the ingest prologue.
+        # * wire-form array (dtype == the var's declared wire_dtype) —
+        #   kept narrow; cast/normalize compiled into the step.
+        # * anything else — legacy: host-side asarray cast to var dtype.
+        ingest_specs, packed_sig = [], None
+        if isinstance(feed, _ingest.PackedBatch):
+            buf = feed.buffer
+            if self.strategy is not None and isinstance(buf, np.ndarray):
+                # unscattered host buffer under a mesh: replicate (still
+                # one transfer per device; semantically the same global
+                # batch). Staging normally pre-scatters per shard.
+                buf = jax.device_put(buf, self.strategy.replicated())
+            for slot in feed.layout:
+                spec = _ingest_spec(block.var_or_none(slot.name),
+                                    slot.dtype, slot.name, packed=True)
+                if spec is not None:
+                    ingest_specs.append(spec)
+            packed_sig = feed.signature()
+            feed_arrays = {_ingest.PACKED_FEED: buf}
+            feed_sig = (("@packed@",) + packed_sig,)
+        else:
+            feed_arrays = {}
+            for name, value in feed.items():
+                var = block.var_or_none(name)
+                spec = _ingest_spec(var, getattr(value, "dtype",
+                                                 np.asarray(value).dtype),
+                                    name)
+                if spec is not None:
+                    ingest_specs.append(spec)
+                    arr = jnp.asarray(value)  # stays in wire dtype
+                else:
+                    dtype = convert_dtype(var.dtype) if var is not None \
+                        else None
+                    arr = jnp.asarray(value, dtype=dtype)
+                feed_arrays[name] = arr
+            feed_sig = tuple(sorted((n, tuple(a.shape), str(a.dtype))
+                                    for n, a in feed_arrays.items()))
+        ingest_specs = tuple(sorted(ingest_specs))
 
         from .. import config as _config
         check_nan_inf = bool(_config.get_flag("check_nan_inf"))
@@ -337,13 +403,13 @@ class Executor:
         amp = _config.get_flag("amp")
         flash = bool(_config.get_flag("flash_attention"))
         precision = _config.get_flag("matmul_precision")
-        feed_sig = tuple(sorted((n, tuple(a.shape), str(a.dtype))
-                                for n, a in feed_arrays.items()))
-        # every trace-time flag must key the compile cache
+        # every trace-time flag must key the compile cache; the ingest
+        # prologue (wire widening + packed unpack) is trace-time too
         key = (program._uid, program._version, feed_sig, tuple(fetch_names),
                bool(donate_state),
                self.strategy._uid if self.strategy is not None else None,
-               check_nan_inf, amp, flash, precision, nonfinite_guard)
+               check_nan_inf, amp, flash, precision, nonfinite_guard,
+               ingest_specs)
         telemetry = bool(_config.get_flag("telemetry"))
         entry = self._cache.get(key)
         if entry is None:
@@ -351,7 +417,7 @@ class Executor:
                 _CACHE_MISSES.inc()
             built = self._build(program, block, feed_sig, fetch_names,
                                 donate_state, check_nan_inf, amp,
-                                nonfinite_guard)
+                                nonfinite_guard, ingest_specs, packed_sig)
             entry = _CacheEntry(*built, key_id="k%d" % next(_KEY_IDS))
             self._cache[key] = entry
         elif telemetry and count_cache:
@@ -377,7 +443,10 @@ class Executor:
             # Scatter feeds over the mesh batch axis; pin state to its
             # PartitionSpec (no-op when already placed). GSPMD propagates
             # shardings through the step and inserts ICI collectives.
-            feed_arrays = {n: self.strategy.shard_feed(n, a)
+            # A packed buffer is already placed (scattered per shard by
+            # the staging thread, or replicated above) — leave it be.
+            feed_arrays = {n: a if n == _ingest.PACKED_FEED
+                           else self.strategy.shard_feed(n, a)
                            for n, a in feed_arrays.items()}
             state_rw = {n: self.strategy.shard_state(n, a)
                         for n, a in state_rw.items()}
@@ -519,7 +588,8 @@ class Executor:
         return fn, (state, feed)
 
     def _build(self, program, block, feed_sig, fetch_names, donate_state,
-               check_nan_inf=False, amp=None, nonfinite_guard=False):
+               check_nan_inf=False, amp=None, nonfinite_guard=False,
+               ingest_specs=(), packed_sig=None):
         read, written, needs_rng = _block_io(block)
         if needs_rng:
             written.add(RNG_STATE_VAR)
@@ -533,7 +603,22 @@ class Executor:
         precision = _config.resolve_matmul_precision()
         strategy = self.strategy
 
+        packed_layout = packed_sig[0] if packed_sig is not None else None
+
         def fn(state_rw, state_ro, feed):
+            # Ingest prologue: unpack the single-copy buffer (static
+            # slices + bitcasts) and widen/normalize wire-dtype feeds to
+            # their model dtype — all inside the compiled step, so the
+            # wide batch exists only in HBM and XLA fuses the casts into
+            # the first consumers.
+            if packed_layout is not None:
+                feed = _ingest.unpack(feed[_ingest.PACKED_FEED],
+                                      packed_layout)
+            if ingest_specs:
+                feed = dict(feed)
+                for name, tgt, scale, mean, std in ingest_specs:
+                    feed[name] = _ingest.widen(feed[name], tgt,
+                                               scale, mean, std)
             env = {}
             env.update(state_ro)
             env.update(state_rw)
@@ -569,7 +654,14 @@ class Executor:
                     for n, v in new_state.items()}
             return new_state, fetches, trace.nan_guards or {}
 
-        jit_kwargs = {}
+        # Donation: state updates are always in-place (argnum 0); a
+        # packed ingest buffer (argnum 2) is consumed by exactly one
+        # step, so donating it lets XLA reuse its HBM for the widened
+        # batch — depth-2 prefetch without doubling ingest memory.
+        donate = []
         if donate_state:
-            jit_kwargs["donate_argnums"] = (0,)
+            donate.append(0)
+        if packed_sig is not None:
+            donate.append(2)
+        jit_kwargs = {"donate_argnums": tuple(donate)} if donate else {}
         return (jax.jit(fn, **jit_kwargs), read_t, written_t, needs_rng)

@@ -1,0 +1,210 @@
+"""Narrow-wire ingest: packed single-copy feeds + on-device widening.
+
+The feed path's remaining cost after async double-buffering
+(reader/staging.py) is *bytes on the wire and dispatches per batch*
+(PROFILE.md round 5: 8.8 ms compute vs 328.9 ms H2D for a 4.8 MB f32
+batch). This module owns the two levers:
+
+* **Packing** — all feed arrays of one batch laid out into ONE
+  contiguous uint8 block (64-byte-aligned slots), transferred with one
+  ``jax.device_put`` instead of one per array. The block is shaped
+  ``(shards, shard_nbytes)`` so a data-parallel mesh can scatter row
+  ``s`` straight to device ``s`` (no replicated full-batch transfer).
+  The executor unpacks *inside* the compiled step via static slices +
+  ``bitcast_convert_type`` — free for XLA to fuse, and the consumed
+  ingest buffer is donated so depth-2 prefetch doesn't double HBM.
+* **Widening** — feeds travel in their wire dtype (uint8 images, int32
+  ids) and are cast/normalized to the model dtype on device
+  (``widen``), compiled into the step like amp/nonfinite_guard.
+
+Host-side packing works with or without the native buddy arena: the
+caller passes an ``alloc`` callback for arena blocks and gets a plain
+numpy fallback otherwise.
+"""
+
+import collections
+
+import numpy as np
+
+from .framework import convert_dtype
+
+__all__ = ["FeedSlot", "PackedBatch", "PACKED_FEED", "plan_layout",
+           "pack_feed", "unpack", "widen", "canon_norm"]
+
+# Reserved feed name the executor binds a PackedBatch's buffer to.
+PACKED_FEED = "@PACKED_FEED@"
+
+# Host copy / slot alignment. 64 keeps every slot base cache-line
+# aligned inside the arena block (the buddy arena already aligns the
+# block base) so the staging memcpys run at full host bandwidth.
+_ALIGN = 64
+
+# One packed slot, all static: name, wire dtype (str), rows per shard,
+# per-sample trailing shape, byte offset/extent within one shard row.
+# The tuple is the compile-cache signature — two batches with the same
+# layout share one executor entry.
+FeedSlot = collections.namedtuple(
+    "FeedSlot", ["name", "dtype", "rows", "sample_shape", "offset",
+                 "nbytes"])
+
+
+class PackedBatch:
+    """One batch as a single (shards, shard_nbytes) uint8 buffer.
+
+    ``buffer`` starts as host numpy (possibly an arena-backed view) and
+    is replaced by the staged device array once transferred;
+    ``transfer_done`` is set by the staging thread after the H2D
+    completes, which is what makes recycling the arena block safe even
+    though the executor donates the device buffer.
+    """
+
+    __slots__ = ("buffer", "layout", "shards", "shard_nbytes",
+                 "batch_size", "transfer_done")
+
+    def __init__(self, buffer, layout, shards, shard_nbytes, batch_size):
+        self.buffer = buffer
+        self.layout = layout
+        self.shards = shards
+        self.shard_nbytes = shard_nbytes
+        self.batch_size = batch_size
+        self.transfer_done = False
+
+    def signature(self):
+        """Hashable layout key for the executor compile cache."""
+        return (self.layout, self.shards, self.shard_nbytes)
+
+    @property
+    def nbytes(self):
+        return self.shards * self.shard_nbytes
+
+
+def _canon_array(value):
+    """Host-canonicalize one feed array for the wire: the no-x64 dtype
+    mapping (int64 -> int32 etc., framework.convert_dtype) applied
+    BEFORE transfer, so ids/labels cross at 4 bytes instead of 8."""
+    arr = np.asarray(value)
+    dt = convert_dtype(arr.dtype)
+    if np.dtype(dt) != arr.dtype:
+        arr = arr.astype(dt)
+    return np.ascontiguousarray(arr)
+
+
+def _align(n):
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def plan_layout(feed, shards=1):
+    """(arrays, layout, shard_nbytes, batch) for a packable feed dict,
+    or None when the batch can't be packed (caller falls back to the
+    per-array path): empty arrays, mismatched leading dims, or a batch
+    the shard count doesn't divide."""
+    if not feed:
+        return None
+    arrays, batch = {}, None
+    for name in sorted(feed):
+        arr = _canon_array(feed[name])
+        if arr.ndim == 0 or arr.nbytes == 0:
+            return None
+        if batch is None:
+            batch = arr.shape[0]
+        elif arr.shape[0] != batch:
+            return None
+        arrays[name] = arr
+    if not batch or batch % shards:
+        return None
+    rows = batch // shards
+    layout, off = [], 0
+    for name, arr in arrays.items():
+        if arr.nbytes % shards:
+            return None
+        nb = arr.nbytes // shards
+        layout.append(FeedSlot(name, np.dtype(arr.dtype).name, rows,
+                               tuple(arr.shape[1:]), off, nb))
+        off = _align(off + nb)
+    return arrays, tuple(layout), _align(off), batch
+
+
+def pack_feed(feed, shards=1, alloc=None):
+    """Pack ``feed`` into one host block; returns (PackedBatch, handle)
+    or None. ``alloc(nbytes) -> (uint8 view, handle) | (None, None)``
+    supplies staging memory (the buddy arena); numpy otherwise."""
+    plan = plan_layout(feed, shards)
+    if plan is None:
+        return None
+    arrays, layout, shard_nbytes, batch = plan
+    total = shards * shard_nbytes
+    buf, handle = (None, None)
+    if alloc is not None:
+        buf, handle = alloc(total)
+    if buf is None:
+        buf, handle = np.empty(total, np.uint8), None
+    buf2d = buf.reshape(shards, shard_nbytes)
+    rows = batch // shards
+    for slot in layout:
+        arr = arrays[slot.name]
+        for s in range(shards):
+            dst = buf2d[s, slot.offset:slot.offset + slot.nbytes] \
+                .view(arr.dtype).reshape((rows,) + slot.sample_shape)
+            np.copyto(dst, arr[s * rows:(s + 1) * rows])
+    return PackedBatch(buf2d, layout, shards, shard_nbytes, batch), handle
+
+
+def unpack(buf, layout):
+    """Traceable inverse of ``pack_feed``: static slices of the
+    (shards, shard_nbytes) uint8 buffer bitcast back to each feed's
+    wire dtype. Under a data-parallel sharding P(data, None) every
+    slice/bitcast/reshape is shard-local — GSPMD keeps the unpacked
+    feeds batch-sharded with zero collectives."""
+    import jax
+    shards = buf.shape[0]
+    out = {}
+    for slot in layout:
+        dt = convert_dtype(slot.dtype)
+        k = np.dtype(dt).itemsize
+        seg = jax.lax.slice_in_dim(buf, slot.offset,
+                                   slot.offset + slot.nbytes, axis=1)
+        if k > 1:
+            seg = jax.lax.bitcast_convert_type(
+                seg.reshape(shards, slot.nbytes // k, k), dt)
+        elif np.dtype(dt) != np.uint8:
+            seg = jax.lax.bitcast_convert_type(seg, dt)
+        out[slot.name] = seg.reshape((shards * slot.rows,)
+                                     + slot.sample_shape)
+    return out
+
+
+def canon_norm(v):
+    """Hashable form of a scale/mean/std attr for compile-cache keys."""
+    if v is None:
+        return None
+    arr = np.asarray(v, np.float32)
+    if arr.ndim == 0:
+        return float(arr)
+    return tuple(float(x) for x in arr.reshape(-1))
+
+
+def widen(x, target_dtype, scale=None, mean=None, std=None):
+    """The on-device ingest prologue for one feed: cast the wire array
+    to the model dtype, then the standard normalize chain
+    ``(x * scale - mean) / std`` (each stage optional). A length-C
+    vector attr broadcasts over the channel axis (axis 1 of NCHW);
+    scalars broadcast everywhere. Runs inside the jitted step, so XLA
+    fuses it with the first consumers and the f32 batch never exists
+    in host memory or on the wire."""
+    import jax.numpy as jnp
+    dt = convert_dtype(target_dtype)
+    x = x.astype(dt)
+
+    def _b(v):
+        v = jnp.asarray(v, dt)
+        if v.ndim == 1 and x.ndim >= 2 and v.shape[0] == x.shape[1]:
+            return v.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return v
+
+    if scale is not None:
+        x = x * _b(scale)
+    if mean is not None:
+        x = x - _b(mean)
+    if std is not None:
+        x = x / _b(std)
+    return x

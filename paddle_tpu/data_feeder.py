@@ -13,9 +13,26 @@ from .core.framework import Variable, convert_dtype
 __all__ = ["DataFeeder", "pad_batch", "bucket_batch_by_length"]
 
 
+def _index_dtype():
+    """Allocation dtype for ids/lengths buffers: the width they will
+    actually cross the wire in (int32 unless jax x64 is on) — padding
+    in int64 just to down-cast device-side doubles the H2D bytes."""
+    return convert_dtype("int64")
+
+
+def _feed_dtype(var):
+    """Buffer dtype for a feed var: its wire_dtype when declared (the
+    narrow-wire path keeps batches in wire form end-to-end; the
+    executor widens on device), else the model dtype."""
+    if not isinstance(var, Variable):
+        return None
+    wd = getattr(var, "wire_dtype", None)
+    return wd if wd is not None else convert_dtype(var.dtype)
+
+
 def pad_batch(seqs, pad_value=0, maxlen=None, dtype=None):
     """list of 1-D/2-D samples -> (padded [N,T,...], lengths [N])."""
-    lengths = np.array([len(s) for s in seqs], dtype="int64")
+    lengths = np.array([len(s) for s in seqs], dtype=_index_dtype())
     t = int(maxlen or lengths.max())
     first = np.asarray(seqs[0])
     tail_shape = first.shape[1:]
@@ -64,9 +81,10 @@ def _pad_sparse(col, depth):
 
     norm = [rows_of(s, depth) for s in col]
     b = len(norm)
+    idt = _index_dtype()
     if depth == 0:
         k = max(max((len(r[0]) for r in norm), default=1), 1)
-        ids = np.zeros((b, k), "int64")
+        ids = np.zeros((b, k), idt)
         vals = np.zeros((b, k), "float32")
         for i, (rid, rv) in enumerate(norm):
             ids[i, :len(rid)] = rid
@@ -75,9 +93,9 @@ def _pad_sparse(col, depth):
     if depth == 1:
         t = max(max((len(s) for s in norm), default=1), 1)
         k = max(max((len(r[0]) for s in norm for r in s), default=1), 1)
-        ids = np.zeros((b, t, k), "int64")
+        ids = np.zeros((b, t, k), idt)
         vals = np.zeros((b, t, k), "float32")
-        lens = np.zeros((b,), "int64")
+        lens = np.zeros((b,), idt)
         for i, s in enumerate(norm):
             lens[i] = len(s)
             for j, (rid, rv) in enumerate(s):
@@ -89,10 +107,10 @@ def _pad_sparse(col, depth):
     t = max(max((len(sub) for s in norm for sub in s), default=1), 1)
     k = max(max((len(r[0]) for s in norm for sub in s for r in sub),
                 default=1), 1)
-    ids = np.zeros((b, s_max, t, k), "int64")
+    ids = np.zeros((b, s_max, t, k), idt)
     vals = np.zeros((b, s_max, t, k), "float32")
-    lens = np.zeros((b,), "int64")
-    subl = np.zeros((b, s_max), "int64")
+    lens = np.zeros((b,), idt)
+    subl = np.zeros((b, s_max), idt)
     for i, s in enumerate(norm):
         lens[i] = len(s)
         for j, sub in enumerate(s):
@@ -119,9 +137,15 @@ def _pad_nested(col, dtype):
         if first is not None:
             break
     tail = first.shape if first is not None and first.ndim else ()
-    data = np.zeros((b, s_max, t) + tail, dtype or "float32")
-    lens = np.zeros((b,), "int64")
-    subl = np.zeros((b, s_max), "int64")
+    if dtype is None:
+        # allocate in the data's own (canonicalized) width — integer
+        # sub-sequences (ids) must not materialize as f32 padded
+        # buffers just because no dtype was declared
+        dtype = convert_dtype(first.dtype) if first is not None \
+            else "float32"
+    data = np.zeros((b, s_max, t) + tail, dtype)
+    lens = np.zeros((b,), _index_dtype())
+    subl = np.zeros((b, s_max), _index_dtype())
     for i, s in enumerate(col):
         lens[i] = len(s)
         for j, sub in enumerate(s):
@@ -182,18 +206,14 @@ class DataFeeder:
                 if self.seq_buckets:
                     maxlen = bucket_batch_by_length(maxlen,
                                                     self.seq_buckets)
-                dtype = convert_dtype(var.dtype) if isinstance(
-                    var, Variable) else None
                 padded, lengths = pad_batch(col, maxlen=maxlen,
-                                            dtype=dtype)
+                                            dtype=_feed_dtype(var))
                 out[name] = padded
                 lname = len_var.name if isinstance(len_var, Variable) \
                     else len_var
                 out[lname] = lengths
             else:
-                dtype = convert_dtype(var.dtype) if isinstance(
-                    var, Variable) else None
-                arr = np.asarray(col, dtype=dtype)
+                arr = np.asarray(col, dtype=_feed_dtype(var))
                 if isinstance(var, Variable) and var.shape is not None \
                         and arr.ndim == len(var.shape) - 1:
                     # scalar-per-sample fields get the trailing [*,1] the

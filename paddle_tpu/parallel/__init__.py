@@ -68,6 +68,7 @@ class DistStrategy:
     """
 
     _uid_counter = [0]
+    _scatter_fallback_logged = False
 
     def __init__(self, mesh, data_axis="data", param_rules=None,
                  model_axis="model"):
@@ -83,6 +84,14 @@ class DistStrategy:
 
     def _named(self, spec):
         return NamedSharding(self.mesh, spec)
+
+    def data_shards(self):
+        """Size of the data axis (1 = no batch sharding) — how many
+        ways the staging thread splits a packed batch."""
+        if self.data_axis is None:
+            return 1
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        return sizes.get(self.data_axis, 1)
 
     def replicated(self):
         return self._named(P())
@@ -110,10 +119,47 @@ class DistStrategy:
                 return self._named(P(*spec_t))
         return self.replicated()
 
+    def _scatter_host(self, array, sharding):
+        """Per-shard H2D: split the host array along the sharding's
+        index map and transfer each shard straight to its device, then
+        assemble the global array — the batch never crosses the wire
+        replicated. Returns (global_array, n_transfers)."""
+        idx_map = sharding.addressable_devices_indices_map(array.shape)
+        shards = [jax.device_put(np.ascontiguousarray(array[idx]), d)
+                  for d, idx in idx_map.items()]
+        return jax.make_array_from_single_device_arrays(
+            array.shape, sharding, shards), len(shards)
+
     def shard_feed(self, name, array):
         """Place a host array with its sharding (scatter across devices)."""
-        return jax.device_put(array,
-                              self.feed_sharding(name, np.ndim(array)))
+        sharding = self.feed_sharding(name, np.ndim(array))
+        if isinstance(array, np.ndarray) and array.ndim:
+            try:
+                return self._scatter_host(array, sharding)[0]
+            except Exception as e:  # noqa: BLE001 — placement must not crash
+                # odd shapes/dtypes: let device_put place it — but say
+                # so ONCE, because this path silently re-pays the
+                # replicated full-batch transfer the scatter avoids
+                if not DistStrategy._scatter_fallback_logged:
+                    DistStrategy._scatter_fallback_logged = True
+                    import logging
+                    logging.getLogger("paddle_tpu").warning(
+                        "per-shard feed scatter failed for %r (%s); "
+                        "falling back to replicated device_put "
+                        "(logged once)", name, e)
+        return jax.device_put(array, sharding)
+
+    def scatter_packed(self, buf):
+        """Scatter a packed ingest block (shards, shard_nbytes) row-wise
+        over the data axis — row s rides one H2D to mesh device s (and
+        to each replica of it on any orthogonal axis). Returns
+        (global_array, n_transfers). Replicates when there is no data
+        axis or the shard count doesn't match it."""
+        if self.data_axis is not None and buf.shape[0] > 1 and \
+                buf.shape[0] % self.data_shards() == 0:
+            return self._scatter_host(
+                buf, self._named(P(self.data_axis, None)))
+        return self._scatter_host(buf, self.replicated())
 
     def shard_state(self, name, array):
         return jax.device_put(array,

@@ -36,6 +36,8 @@ import time
 import numpy as np
 
 from .. import config as _config
+from ..core import ingest as _ingest
+from ..core.framework import convert_dtype
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
 
@@ -67,6 +69,21 @@ _ARENA_PEAK = _metrics.REGISTRY.gauge(
     "paddle_staging_arena_peak_bytes",
     "Buddy-arena high-water mark, per reader",
     labelnames=("reader",))
+# Narrow-wire accounting: what actually crossed H2D vs what the legacy
+# widened path would have moved, and how many device_put dispatches it
+# took. bench_resnet_pipeline asserts exactly one dispatch per batch on
+# the packed path via the transfers counter.
+_WIRE_BYTES = _metrics.REGISTRY.counter(
+    "paddle_staging_wire_bytes_total",
+    "Bytes actually transferred host->device by staging")
+_LEGACY_BYTES = _metrics.REGISTRY.counter(
+    "paddle_staging_legacy_bytes_total",
+    "Bytes the pre-wire path (widened dtypes, per-array device_put) "
+    "would have transferred for the same batches")
+_TRANSFERS = _metrics.REGISTRY.counter(
+    "paddle_staging_h2d_transfers_total",
+    "device_put dispatches issued by staging (packed path: one per "
+    "batch per mesh shard)")
 _READER_IDS = itertools.count(1)
 
 
@@ -116,15 +133,30 @@ class StagedReader:
     plain numpy staging for the overflowing arrays.
     device_put: dispatch jax.device_put on the staging thread (H2D in
     flight before the consumer sees the feed).
+    pack: pack each batch into ONE contiguous block and issue ONE
+    device_put (core/ingest.py); the executor unpacks inside the
+    compiled step. None (default) follows the ``packed_feeds`` config
+    flag. Unpackable batches (ragged leading dims) fall back per-batch
+    to the per-array path.
+    strategy: a parallel.DistStrategy — packed batches are split on
+    host over its data axis and scattered shard-per-device
+    (jax.make_array_from_single_device_arrays), so a multi-chip feed
+    costs one per-shard transfer each instead of a replicated
+    full-batch transfer.
     """
 
     def __init__(self, reader, feeder=None, depth=2, capacity_mb=256,
-                 device_put=True, free_lag=2):
+                 device_put=True, free_lag=2, pack=None, strategy=None,
+                 program=None):
         self.reader = reader
         self.feeder = feeder
         self.depth = max(1, int(depth))
         self.device_put = device_put
         self.free_lag = max(0, int(free_lag))
+        self.pack = pack
+        self.strategy = strategy
+        self.program = program  # for feed-var dtype lookups (telemetry)
+        self.packed_batches = 0
         # recent (stage_start, stage_end) windows; bounded — only the
         # overlap test and debugging read these
         self.records = collections.deque(maxlen=1024)
@@ -148,15 +180,96 @@ class StagedReader:
     # -- stats ----------------------------------------------------------
     def stats(self):
         s = {"staged_batches": self.staged_batches,
+             "packed_batches": self.packed_batches,
              "arena_active": self.arena_active}
         if self._arena is not None:
             s["arena_peak_bytes"] = self._arena.peak()
             s["arena_in_use_bytes"] = self._arena.in_use()
         return s
 
+    def packing_enabled(self):
+        return self.device_put and (
+            self.pack if self.pack is not None
+            else bool(_config.get_flag("packed_feeds")))
+
     # -- staging thread --------------------------------------------------
+    def _legacy_nbytes(self, name, arr):
+        """What the pre-wire path would have moved for this array: the
+        wider of its original width and the var's model dtype (a uint8
+        wire image would have crossed as f32; an int64 label crossed as
+        int64 before the host canon to int32)."""
+        nbytes = arr.nbytes
+        var = self._feed_var(name)
+        if var is not None:
+            try:
+                tgt = np.dtype(convert_dtype(var.dtype))
+                nbytes = max(nbytes, arr.size * tgt.itemsize)
+            except TypeError:
+                pass  # bf16 scalar-type target: keep original width
+        return nbytes
+
+    def _feed_var(self, name):
+        from ..core.framework import Variable
+        if self.feeder is not None:
+            for kind, var, len_var in self.feeder.feed_specs:
+                for v in (var, len_var):
+                    if isinstance(v, Variable) and v.name == name:
+                        return v
+        if self.program is not None:
+            return self.program.global_block().var_or_none(name)
+        return None
+
+    def _stage_packed(self, feed):
+        """Fused single-copy path: one arena block, one device_put (one
+        per mesh shard under a data-parallel strategy). Returns
+        (PackedBatch, ptrs) or None to fall back."""
+        shards = self.strategy.data_shards() \
+            if self.strategy is not None else 1
+
+        def alloc(n):
+            if self._arena is None:
+                return None, None
+            return self._arena.alloc_array((n,), np.uint8, n)
+
+        packed = _ingest.pack_feed(feed, shards=shards, alloc=alloc)
+        if packed is None:
+            return None
+        pb, ptr = packed
+        telemetry = _config.get_flag("telemetry")
+        if telemetry:
+            _LEGACY_BYTES.inc(sum(
+                self._legacy_nbytes(n, np.asarray(v))
+                for n, v in feed.items()))
+        if self.device_put:
+            import jax
+            if self.strategy is not None:
+                # scatter_packed places on the mesh even when the data
+                # axis is trivial (replicated) — a single-device-placed
+                # buffer would collide with mesh-sharded state inputs
+                pb.buffer, n_put = self.strategy.scatter_packed(pb.buffer)
+            else:
+                pb.buffer, n_put = jax.device_put(pb.buffer), 1
+            # Transfer-completion barrier ON the staging thread: the
+            # executor donates the device buffer, so nobody may touch
+            # it after the step — completing the DMA here is what keeps
+            # the arena recycle (and free_lag=0) safe under donation.
+            jax.block_until_ready(pb.buffer)
+            pb.transfer_done = True
+            if telemetry:
+                _WIRE_BYTES.inc(pb.nbytes)
+                _TRANSFERS.inc(n_put)
+        self.packed_batches += 1
+        return pb, ([ptr] if ptr is not None else [])
+
     def _stage_feed(self, feed):
         """Copy arrays into arena blocks; returns (staged_feed, ptrs)."""
+        if isinstance(feed, _ingest.PackedBatch):
+            return feed, []  # reader yielded a pre-packed batch
+        if self.packing_enabled():
+            out = self._stage_packed(feed)
+            if out is not None:
+                return out
+        telemetry = _config.get_flag("telemetry")
         staged, ptrs = {}, []
         for name, value in feed.items():
             arr = np.asarray(value)
@@ -173,6 +286,10 @@ class StagedReader:
             if self.device_put:
                 import jax
                 dst = jax.device_put(dst)
+                if telemetry:
+                    _WIRE_BYTES.inc(arr.nbytes)
+                    _LEGACY_BYTES.inc(self._legacy_nbytes(name, arr))
+                    _TRANSFERS.inc()
             staged[name] = dst
         return staged, ptrs
 
@@ -182,6 +299,14 @@ class StagedReader:
         numpy entries (device_put=False or fallback staging) pass
         through — they have no in-flight DMA."""
         import jax
+        if isinstance(staged, _ingest.PackedBatch):
+            if not staged.transfer_done and \
+                    not isinstance(staged.buffer, np.ndarray):
+                try:
+                    jax.block_until_ready(staged.buffer)
+                except RuntimeError:
+                    pass  # donated to a step that already consumed it
+            return
         arrays = [v for v in staged.values()
                   if not isinstance(v, np.ndarray)]
         if arrays:

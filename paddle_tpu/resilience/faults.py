@@ -149,12 +149,33 @@ def fire_point(site, index=None, default_exc=None):
 def poison_feed(feed, step):
     """``nan_loss`` hook: overwrite the first float feed array with NaN
     (in a copy) when armed for ``step``, so a genuinely non-finite loss
-    flows through the unmodified train computation."""
+    flows through the unmodified train computation. Packed batches
+    (core/ingest.py) are poisoned in place of their first float slot's
+    byte region, so the fused single-copy path stays on its own code
+    path under chaos testing."""
     import numpy as np
     if should_fire("nan_loss", step) is None:
         return feed
     _log.structured("fault_injected", site="nan_loss", index=step,
                     action="poison")
+    from ..core.ingest import PackedBatch
+    if isinstance(feed, PackedBatch):
+        for slot in feed.layout:
+            dt = np.dtype(slot.dtype)
+            if not np.issubdtype(dt, np.floating):
+                continue
+            import jax.numpy as jnp
+            nan_bytes = np.frombuffer(
+                np.full(slot.nbytes // dt.itemsize, np.nan, dt)
+                .tobytes(), np.uint8)
+            buf = jnp.asarray(feed.buffer).at[
+                :, slot.offset:slot.offset + slot.nbytes].set(
+                jnp.asarray(nan_bytes))
+            poisoned = PackedBatch(buf, feed.layout, feed.shards,
+                                   feed.shard_nbytes, feed.batch_size)
+            poisoned.transfer_done = True
+            return poisoned
+        return feed
     out = dict(feed)
     for name, v in out.items():
         arr = np.asarray(v)

@@ -55,6 +55,9 @@ _CKPT_SECONDS = _metrics.REGISTRY.histogram(
 
 def _batch_size(feed):
     """Largest leading dim across feed arrays (examples in this step)."""
+    from .core.ingest import PackedBatch
+    if isinstance(feed, PackedBatch):
+        return feed.batch_size
     n = 0
     for v in feed.values():
         shape = getattr(v, "shape", None)
@@ -292,8 +295,10 @@ class Trainer:
         if staging and prefetch:
             from .reader.staging import StagedReader
             staged = StagedReader(reader, feeder=self.feeder,
-                                  depth=prefetch)
-            if not staged.arena_active:
+                                  depth=prefetch,
+                                  strategy=self.exe.strategy,
+                                  program=self.main_program)
+            if not (staged.arena_active or staged.packing_enabled()):
                 staged = None  # native arena unavailable
         batches = None
         exc_live = False

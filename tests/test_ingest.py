@@ -1,0 +1,353 @@
+"""Narrow-wire input pipeline (core/ingest.py + executor prologue +
+staged packing + sharded feeds).
+
+Covers the ISSUE-4 contract:
+* wire-dtype round trip — a uint8 feed widened/normalized ON DEVICE
+  matches the host-f32 path bit-for-bit over 3 train steps (the host
+  reference normalizes through the same XLA arithmetic; plain numpy
+  differs by FMA contraction, asserted to tolerance separately);
+* fused pack/unpack correctness for multi-feed, multi-dtype programs;
+* arena ``free_lag`` safety with the single-block transfer (free_lag=0
+  is the hardest recycle schedule; the staging thread's
+  transfer-completion barrier is what makes it safe under donation);
+* sharded-feed equality with the replicated path on a 2-device mesh;
+* flags off => legacy behavior (no packing, per-array transfers).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers, parallel
+from paddle_tpu.core import ingest
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.reader.staging import StagedReader
+from paddle_tpu.trainer import Trainer, EndIteration
+
+pytestmark = pytest.mark.pipeline
+
+MEAN = (0.485, 0.456, 0.406)
+STD = (0.229, 0.224, 0.225)
+SCALE = 1.0 / 255.0
+
+
+# -- pack/unpack unit level ----------------------------------------------
+
+def _multi_feed(batch=8, seed=0):
+    rs = np.random.RandomState(seed)
+    return {"img": rs.randint(0, 256, (batch, 3, 5, 7)).astype("uint8"),
+            "ids": rs.randint(0, 1000, (batch, 11)).astype("int32"),
+            "lbl": rs.randint(0, 10, (batch, 1)).astype("int64"),
+            "x": rs.randn(batch, 13).astype("float32")}
+
+
+def test_pack_unpack_roundtrip_multi_dtype():
+    feed = _multi_feed()
+    pb, handle = ingest.pack_feed(feed)
+    assert handle is None  # numpy fallback (no arena alloc passed)
+    assert pb.shards == 1 and pb.batch_size == 8
+    out = ingest.unpack(jnp.asarray(pb.buffer), pb.layout)
+    assert sorted(out) == sorted(feed)
+    np.testing.assert_array_equal(np.asarray(out["img"]), feed["img"])
+    np.testing.assert_array_equal(np.asarray(out["ids"]), feed["ids"])
+    np.testing.assert_array_equal(np.asarray(out["x"]), feed["x"])
+    # int64 crosses the wire canonicalized to int32 (no-x64 policy)
+    assert np.asarray(out["lbl"]).dtype == np.int32
+    np.testing.assert_array_equal(np.asarray(out["lbl"]),
+                                  feed["lbl"].astype("int32"))
+
+
+def test_pack_unpack_sharded_layout():
+    feed = _multi_feed()
+    pb, _ = ingest.pack_feed(feed, shards=4)
+    assert pb.buffer.shape[0] == 4
+    out = ingest.unpack(jnp.asarray(pb.buffer), pb.layout)
+    for name in feed:
+        want = feed[name] if feed[name].dtype != np.int64 \
+            else feed[name].astype("int32")
+        np.testing.assert_array_equal(np.asarray(out[name]), want)
+
+
+def test_pack_slot_alignment_and_fallbacks():
+    pb, _ = ingest.pack_feed(_multi_feed())
+    for slot in pb.layout:
+        assert slot.offset % 64 == 0
+    # ragged leading dims / shard-indivisible batches can't pack
+    rs = np.random.RandomState(0)
+    assert ingest.pack_feed({"a": rs.randn(4, 3), "b": rs.randn(5, 3)}) \
+        is None
+    assert ingest.pack_feed({"a": rs.randn(6, 3)}, shards=4) is None
+    assert ingest.pack_feed({}) is None
+
+
+# -- wire-dtype round trip through the executor --------------------------
+
+def _build_wire_model(wire):
+    main, startup = ptpu.Program(), ptpu.Program()
+    main.random_seed = startup.random_seed = 5
+    with ptpu.program_guard(main, startup):
+        if wire:
+            img = layers.data("img", shape=[3, 8, 8], wire_dtype="uint8",
+                              scale=SCALE, mean=MEAN, std=STD)
+        else:
+            img = layers.data("img", shape=[3, 8, 8])
+        y = layers.data("y", shape=[1], dtype="int64",
+                        wire_dtype="int32" if wire else None)
+        h = layers.fc(img, 16, act="relu")
+        logits = layers.fc(h, 10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        ptpu.optimizer.SGD(0.1).minimize(loss, startup_program=startup)
+    return main, startup, loss
+
+
+@jax.jit
+def _host_norm(x):
+    """The host-f32 reference pre-processing, through the same XLA
+    arithmetic the ingest prologue compiles (same FMA decisions)."""
+    m = jnp.asarray(MEAN, jnp.float32).reshape(1, 3, 1, 1)
+    s = jnp.asarray(STD, jnp.float32).reshape(1, 3, 1, 1)
+    return (x.astype(jnp.float32) * np.float32(SCALE) - m) / s
+
+
+def _run_steps(wire, feeds, packed=False):
+    losses = []
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        main, startup, loss = _build_wire_model(wire)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        for u8, y in feeds:
+            if wire and packed:
+                fd, _ = ingest.pack_feed({"img": u8, "y": y})
+            elif wire:
+                fd = {"img": u8, "y": y}
+            else:
+                fd = {"img": np.asarray(_host_norm(u8)), "y": y}
+            val, = exe.run(main, feed=fd, fetch_list=[loss])
+            losses.append(np.asarray(val, np.float32))
+    return np.array(losses)
+
+
+def test_wire_uint8_matches_host_f32_bit_for_bit():
+    rs = np.random.RandomState(3)
+    feeds = [(rs.randint(0, 256, (8, 3, 8, 8)).astype("uint8"),
+              rs.randint(0, 10, (8, 1)).astype("int64"))
+             for _ in range(3)]
+    wire = _run_steps(True, feeds)
+    host = _run_steps(False, feeds)
+    packed = _run_steps(True, feeds, packed=True)
+    # on-device normalize == host normalize, to the bit, for 3 steps of
+    # donated fwd+bwd+update — and the packed single-copy path is
+    # bitwise the same computation again
+    np.testing.assert_array_equal(wire.view(np.uint32),
+                                  host.view(np.uint32))
+    np.testing.assert_array_equal(packed.view(np.uint32),
+                                  wire.view(np.uint32))
+    # numpy-side normalize may differ by FMA contraction only
+    np_host = [(u.astype(np.float32) * np.float32(SCALE)
+                - np.asarray(MEAN, np.float32).reshape(1, 3, 1, 1))
+               / np.asarray(STD, np.float32).reshape(1, 3, 1, 1)
+               for u, _ in feeds]
+    np.testing.assert_allclose(
+        np_host[0], np.asarray(_host_norm(feeds[0][0])), rtol=1e-5,
+        atol=1e-6)
+    assert len(wire) == 3 and np.isfinite(wire).all()
+
+
+def test_wire_feed_keys_compile_cache_separately():
+    rs = np.random.RandomState(4)
+    u8 = rs.randint(0, 256, (4, 3, 8, 8)).astype("uint8")
+    y = rs.randint(0, 10, (4, 1)).astype("int64")
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        main, startup, loss = _build_wire_model(True)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        exe.run(main, feed={"img": u8, "y": y}, fetch_list=[loss])
+        n_wire = len(exe._cache)
+        # widened arrival: legacy path, distinct cache entry
+        exe.run(main, feed={"img": np.asarray(_host_norm(u8)), "y": y},
+                fetch_list=[loss])
+        assert len(exe._cache) == n_wire + 1
+        # packed arrival: third entry
+        pb, _ = ingest.pack_feed({"img": u8, "y": y})
+        exe.run(main, feed=pb, fetch_list=[loss])
+        assert len(exe._cache) == n_wire + 2
+
+
+# -- staged packing through the trainer ----------------------------------
+
+def _feed_reader(n_batches, batch=8, seed=7):
+    def reader():
+        rs = np.random.RandomState(seed)
+        for _ in range(n_batches):
+            yield {"x": rs.randint(0, 256, (batch, 6)).astype("uint8"),
+                   "y": rs.randn(batch, 1).astype("float32")}
+    return reader
+
+
+def _build_linear():
+    main, startup = ptpu.Program(), ptpu.Program()
+    main.random_seed = startup.random_seed = 11
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[6], wire_dtype="uint8", scale=SCALE)
+        y = layers.data("y", shape=[1])
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        ptpu.optimizer.SGD(learning_rate=0.05).minimize(
+            loss, startup_program=startup)
+    return main, startup, loss
+
+
+def _train_losses(packed, strategy=None, n=6):
+    losses = []
+    ptpu.config.set_flags(packed_feeds=packed)
+    try:
+        with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+            main, startup, loss = _build_linear()
+            tr = Trainer(loss, main_program=main, startup_program=startup,
+                         strategy=strategy)
+            tr.train(_feed_reader(n), num_passes=1,
+                     event_handler=lambda e:
+                     losses.append(e.metrics["loss"])
+                     if isinstance(e, EndIteration) else None)
+    finally:
+        ptpu.config.set_flags(packed_feeds=False)
+    return np.array(losses, np.float32)
+
+
+def test_trainer_packed_staging_matches_legacy():
+    plain = _train_losses(packed=False)
+    packed = _train_losses(packed=True)
+    assert len(plain) == len(packed) == 6
+    np.testing.assert_array_equal(plain.view(np.uint32),
+                                  packed.view(np.uint32))
+
+
+def test_sharded_packed_feed_matches_replicated_two_device_mesh():
+    plain = _train_losses(packed=False)
+    strat = parallel.DataParallel(n_devices=2)
+    sharded = _train_losses(packed=True, strategy=strat)
+    np.testing.assert_allclose(plain, sharded, rtol=2e-4, atol=1e-6)
+
+
+def test_packed_single_transfer_per_batch():
+    from paddle_tpu.reader import staging as _staging
+    ptpu.config.set_flags(packed_feeds=True, telemetry=True)
+    try:
+        t0 = _staging._TRANSFERS.value
+        w0 = _staging._WIRE_BYTES.value
+        sr = StagedReader(_feed_reader(5), depth=2)
+        feeds = list(sr())
+        sr.close()
+        assert len(feeds) == 5
+        assert all(isinstance(f, ingest.PackedBatch) for f in feeds)
+        assert _staging._TRANSFERS.value - t0 == 5  # ONE put per batch
+        assert _staging._WIRE_BYTES.value - w0 == \
+            sum(f.nbytes for f in feeds)
+    finally:
+        ptpu.config.set_flags(packed_feeds=False, telemetry=False)
+
+
+def test_packed_free_lag_zero_values_intact():
+    """Hardest recycle schedule: the block is freed as soon as the next
+    batch lands. The staging thread's transfer barrier must make that
+    safe — every consumed batch still matches the source."""
+    src = list(_feed_reader(6)())
+    sr = StagedReader(_feed_reader(6), depth=2, pack=True, free_lag=0,
+                      capacity_mb=4)
+    for got, want in zip(sr(), src):
+        assert isinstance(got, ingest.PackedBatch)
+        out = ingest.unpack(got.buffer, got.layout)
+        np.testing.assert_array_equal(np.asarray(out["x"]), want["x"])
+        np.testing.assert_array_equal(np.asarray(out["y"]), want["y"])
+    stats = sr.stats()
+    sr.close()
+    assert stats["packed_batches"] == 6
+    if stats["arena_active"]:
+        assert stats["arena_in_use_bytes"] == 0  # all blocks recycled
+
+
+def test_flags_off_is_legacy_path():
+    """packed_feeds off => per-array staging, no PackedBatch anywhere,
+    and the feeder still emits plain dicts of numpy arrays."""
+    assert not ptpu.config.get_flag("packed_feeds")
+    sr = StagedReader(_feed_reader(3), depth=2)
+    assert not sr.packing_enabled()
+    feeds = list(sr())
+    sr.close()
+    assert all(isinstance(f, dict) for f in feeds)
+
+
+def test_ragged_batch_falls_back_to_per_array_staging():
+    def ragged():
+        rs = np.random.RandomState(0)
+        yield {"x": rs.randn(4, 3).astype("float32"),
+               "y": rs.randn(5, 1).astype("float32")}  # mismatched B
+
+    sr = StagedReader(ragged, depth=1, pack=True)
+    feeds = list(sr())
+    sr.close()
+    assert len(feeds) == 1 and isinstance(feeds[0], dict)
+    assert sr.packed_batches == 0
+
+
+def test_poison_feed_handles_packed_batch():
+    """Chaos hook parity: nan_loss poisoning must work on the packed
+    path too (overwrite the first float slot's byte region)."""
+    from paddle_tpu.resilience import faults
+    feed = {"x": np.ones((4, 3), np.float32),
+            "i": np.arange(8, dtype=np.int32).reshape(4, 2)}
+    pb, _ = ingest.pack_feed(feed)
+    faults.arm("nan_loss", at=0, times=1, action="callback",
+               callback=lambda *_: None)
+    try:
+        poisoned = faults.poison_feed(pb, 0)
+    finally:
+        faults.disarm()
+    assert isinstance(poisoned, ingest.PackedBatch)
+    out = ingest.unpack(jnp.asarray(poisoned.buffer), poisoned.layout)
+    assert np.isnan(np.asarray(out["x"])).all()  # float slot poisoned
+    np.testing.assert_array_equal(np.asarray(out["i"]), feed["i"])
+    # original batch untouched (staging still owns its arena block)
+    orig = ingest.unpack(jnp.asarray(pb.buffer), pb.layout)
+    assert not np.isnan(np.asarray(orig["x"])).any()
+
+
+# -- feeder wire-dtype allocation ----------------------------------------
+
+def test_feeder_allocates_wire_dtype_buffers():
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            img = layers.data("img", shape=[3, 4, 4], wire_dtype="uint8",
+                              scale=SCALE)
+            lbl = layers.data("lbl", shape=[1], dtype="int64",
+                              wire_dtype="int32")
+        feeder = DataFeeder([img, lbl])
+        batch = [(np.random.randint(0, 256, (3, 4, 4)).astype("uint8"),
+                  [i]) for i in range(4)]
+        out = feeder.feed(batch)
+    assert out["img"].dtype == np.uint8
+    assert out["lbl"].dtype == np.int32
+
+
+def test_feeder_integer_padded_buffers_not_f32():
+    """Satellite: padded sequence buffers for integer specs allocate in
+    the spec's (wire) dtype, not float32."""
+    from paddle_tpu.data_feeder import _pad_nested, pad_batch
+    data, lens, subl = _pad_nested([[[1, 2], [3]], [[4]]], None)
+    assert np.issubdtype(data.dtype, np.integer)
+    assert np.issubdtype(lens.dtype, np.integer)
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            seq = layers.data("seq", shape=[None], dtype="int64",
+                              wire_dtype="int32")
+            slen = layers.data("slen", shape=[], dtype="int64")
+        feeder = DataFeeder([(seq, slen)])
+        out = feeder.feed([([1, 2, 3],), ([4],)])
+    assert out["seq"].dtype == np.int32
+    padded, lengths = pad_batch([[1, 2], [3]])
+    assert np.issubdtype(padded.dtype, np.integer)
