@@ -23,21 +23,35 @@ _lock = threading.Lock()
 _DTYPES = {0: np.float32, 1: np.int32, 2: np.int64}
 
 
-def load_model(dirname, batch_buckets=None):
+def load_model(dirname, batch_buckets=None, deadline_ms=None):
     """Load an inference dir (JSON __model__ + params) -> int handle.
     With ``batch_buckets`` the handle serves through a bucketed
     ServingEngine (padded shapes against the compile cache, AOT-warmed)
     instead of a raw Executor — the C serving path then shares the
-    Python serving layer's shape discipline and metrics."""
+    Python serving layer's shape discipline, metrics, AND resilience:
+    replica breakers/failover arm off the ``serving_breaker_*`` flags,
+    and ``deadline_ms`` (default: the ``serving_deadline_ms`` flag; 0 =
+    none) bounds every forward — an expired call raises
+    ServingDeadlineError before occupying a device."""
+    from . import config as _config
     from . import io as _io
     from .core.executor import Executor
     from .core.scope import Scope, scope_guard
 
+    if deadline_ms and not batch_buckets:  # 0/None = no deadline
+        raise ValueError(
+            "deadline_ms needs the bucketed serving path — pass "
+            "batch_buckets too (the raw-Executor path has no deadline "
+            "enforcement)")
     if batch_buckets:
         from .serving.engine import ServingEngine
         eng = ServingEngine(dirname, buckets=batch_buckets)
+        if deadline_ms is None:
+            flag_ms = _config.get_flag("serving_deadline_ms")
+            deadline_ms = flag_ms if flag_ms else None
         entry = {"serving": eng, "feed_names": list(eng.feed_names),
                  "fetch_names": list(eng.fetch_names),
+                 "deadline_ms": deadline_ms,
                  "lock": threading.Lock()}
     else:
         scope = Scope()
@@ -66,7 +80,9 @@ def forward(handle, inputs):
             [int(s) for s in shape])
         feed[name] = arr
     if "serving" in entry:
-        outs = entry["serving"].run(feed)  # engine is itself thread-safe
+        # engine is itself thread-safe; deadlines/breakers apply here
+        outs = entry["serving"].run(feed,
+                                    deadline_ms=entry["deadline_ms"])
     else:
         with entry["lock"]:
             outs = entry["exe"].run(entry["program"], feed=feed,
@@ -81,7 +97,9 @@ def forward(handle, inputs):
 
 def release(handle):
     with _lock:
-        _models.pop(handle, None)
+        entry = _models.pop(handle, None)
+    if entry and "serving" in entry:
+        entry["serving"].close()  # stop the breaker probe thread
 
 
 def feed_fetch_names(handle):

@@ -29,6 +29,30 @@ serving_buckets: default batch buckets for serving.ServingEngine —
   the executor's compile cache sees a closed set of shapes (engines
   constructed with explicit ``buckets=`` ignore this).
 
+serving_breaker_failures: default per-replica circuit-breaker
+  threshold for ServingEngine — N CONSECUTIVE execution failures (or a
+  single hang past the execution timeout) open the replica's breaker,
+  quarantining it out of round-robin until the half-open probe
+  re-admits it. 0 (default) = breakers off: no breaker objects are
+  constructed and run() keeps the PR-2 fast path (a few None checks
+  per request — the serving analog of the ``telemetry`` off-hot-path
+  guarantee). Engines constructed with explicit ``breaker_failures=``
+  ignore this.
+
+serving_breaker_cooldown_ms: how long an open replica breaker waits
+  before the background probe re-runs a warmed bucket there
+  (half-open); success re-admits the replica, failure re-opens with a
+  fresh cooldown.
+
+serving_deadline_ms: default per-request deadline budget for
+  MicroBatcher.submit (and BUCKETED capi_bridge forwards; the raw
+  non-bucketed C path has no deadline machinery). 0 (default) = no
+  deadline: submit() costs one flag check. When set (or passed
+  per-call as ``deadline_ms=``), already-hopeless submits are shed at
+  the door (ServingOverloadError, queue-wait EWMA projection) and
+  items that expire while queued resolve with ServingDeadlineError
+  BEFORE dispatch, so doomed work never occupies a device.
+
 packed_feeds: if True, reader/staging.py packs every batch's feed
   arrays into ONE contiguous 64B-aligned arena block and issues ONE
   ``jax.device_put`` per batch (one per mesh shard under data
@@ -85,6 +109,10 @@ _flags = {
     "packed_feeds": False,
     "telemetry": False,
     "serving_buckets": (1, 8, 32),
+    # serving resilience (serving/resilience.py; see docstring)
+    "serving_breaker_failures": 0,
+    "serving_breaker_cooldown_ms": 1000.0,
+    "serving_deadline_ms": 0,
     # resilience (resilience/supervisor.py defaults; see docstring)
     "nonfinite_guard": False,
     "nonfinite_policy": "raise",

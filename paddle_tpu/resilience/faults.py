@@ -27,6 +27,18 @@ site                          where / what
 ``master_kill``               ElasticDataDispatcher.reader, once per task
                               lease — arm with a callback that kills (and
                               optionally restarts) the master
+``serving_replica_fail``      ServingEngine._execute, before the replica
+                              lock — ``index`` is the REPLICA number, so
+                              ``at=1`` fails only replica 1 (the breaker/
+                              failover chaos shape)
+``serving_replica_slow``      ServingEngine._execute, inside the replica
+                              lock just before the device run — arm with
+                              ``action="callback"`` sleeping past the
+                              engine timeout to simulate a wedged device
+``serving_overload``          MicroBatcher.submit admission — ``index``
+                              is the submit sequence number; default
+                              exception ServingOverloadError (counted as
+                              a shed)
 ============================  =============================================
 
 Actions: ``"raise"`` (raise ``exc``, default :class:`InjectedFault`),

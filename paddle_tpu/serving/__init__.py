@@ -1,28 +1,46 @@
 """Inference serving subsystem (the reference's ``paddle/capi``
 examples tier, rebuilt TPU-native — see ROADMAP north star).
 
-Three cooperating pieces:
+Four cooperating pieces:
 
-* :mod:`engine`  — :class:`ServingEngine`: loads an exported/merged
+* :mod:`engine`     — :class:`ServingEngine`: loads an exported/merged
   model once, pads requests to fixed batch buckets (the Executor's
   compile cache then sees a closed shape set), AOT-warms every bucket,
-  and dispatches round-robin across device replicas.
-* :mod:`batcher` — :class:`MicroBatcher`: thread-safe
+  and dispatches round-robin across device replicas — skipping
+  replicas whose circuit breaker is open, failing requests over to the
+  next healthy replica.
+* :mod:`batcher`    — :class:`MicroBatcher`: thread-safe
   ``submit(feed) -> Future`` micro-batching with a max-latency
-  deadline and bounded-queue backpressure.
-* :mod:`quant`   — post-training int8 weight quantization
+  deadline, bounded-queue backpressure, per-request serve-by
+  deadlines, EWMA-based adaptive load shedding, and a graceful
+  ``drain()``.
+* :mod:`resilience` — the failure model: :class:`ReplicaBreaker`
+  (closed/open/half-open with background probe re-admission),
+  :class:`ServingDeadlineError` / :class:`ServingTimeoutError` /
+  :class:`ServingUnavailableError`, and the always-on recovery
+  counters (``paddle_serving_failover_total``,
+  ``paddle_serving_breaker_transitions_total``, ...).
+* :mod:`quant`      — post-training int8 weight quantization
   (per-output-channel symmetric scales) wired into
   ``io.save_inference_model(..., quantize="int8")`` and transparently
   dequantized at load.
 
 Everything is instrumented through :mod:`paddle_tpu.observability`;
-``tools/serving_probe.py`` exercises the stack headless and prints the
-Prometheus exposition.
+``tools/serving_probe.py`` exercises the stack headless and
+``tools/serving_chaos_probe.py`` drives it through injected replica
+failures and overload (fault sites ``serving_replica_fail`` /
+``serving_replica_slow`` / ``serving_overload``).
 """
 
 from . import quant  # noqa: F401
+from . import resilience  # noqa: F401
+from .resilience import (ServingDeadlineError,  # noqa: F401
+                         ServingTimeoutError, ServingUnavailableError,
+                         ReplicaBreaker)
 from .engine import ServingEngine  # noqa: F401
 from .batcher import MicroBatcher, ServingOverloadError  # noqa: F401
 
 __all__ = ["ServingEngine", "MicroBatcher", "ServingOverloadError",
-           "quant"]
+           "ServingDeadlineError", "ServingTimeoutError",
+           "ServingUnavailableError", "ReplicaBreaker", "quant",
+           "resilience"]

@@ -9,16 +9,41 @@ through the :class:`~paddle_tpu.serving.engine.ServingEngine` as ONE
 padded-bucket execution. Each caller's Future resolves to its own row
 of the outputs, so the batching is invisible to clients.
 
-Backpressure is a bounded queue: ``submit`` blocks while the queue is
-full (or raises :class:`ServingOverloadError` when a ``timeout`` is
-given) instead of letting an unbounded backlog grow.
+Admission control, outermost first:
+
+* **backpressure** — a bounded queue: ``submit`` blocks while it is
+  full (or raises :class:`ServingOverloadError` when a ``timeout`` is
+  given) instead of letting an unbounded backlog grow.
+* **adaptive shedding** — the dispatcher tracks an EWMA of observed
+  queue waits; a submit carrying a deadline whose budget the projected
+  wait would already blow is shed IMMEDIATELY with
+  :class:`ServingOverloadError`, so overload is refused at the door
+  while the caller can still retry elsewhere, not discovered by a
+  full-queue timeout at the worst moment.
+* **deadlines** — ``submit(feed, deadline_ms=...)`` (default: the
+  ``serving_deadline_ms`` flag; 0 = none) attaches an absolute
+  deadline; items that expire while queued are dropped at dispatch
+  with :class:`ServingDeadlineError` *before* the batch hits a device,
+  so doomed work never occupies one.
+
+Each example is validated against the engine's feed specs at
+``submit`` time, and the flush groups co-batched items by shape, so
+one malformed request can never poison its neighbours' batch.
+
+``drain()`` is the redeploy story: stop admission, serve everything
+already accepted, stop the dispatcher — every accepted Future
+resolves, and the process is left cleanly restartable. ``close()`` is
+the fast exit (bounded wait, leftovers failed). Fault site
+``serving_overload`` (resilience/faults.py) forces sheds for chaos
+tests.
 
 Metrics: ``paddle_serving_request_seconds`` (submit -> result latency
-histogram) and ``paddle_serving_queue_depth`` (gauge). Mean batch
-occupancy is derivable from the engine's ``requests_total`` /
-``batches_total`` counters.
+histogram), ``paddle_serving_queue_depth`` (gauge, reset to 0 on
+close/drain), ``paddle_serving_shed_total`` and
+``paddle_serving_deadline_exceeded_total`` (serving/resilience.py).
 """
 
+import itertools
 import queue
 import threading
 import time
@@ -26,8 +51,12 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from .. import config as _config
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
+from ..resilience import faults as _faults
+from . import resilience as _sres
+from .resilience import ServingDeadlineError
 
 __all__ = ["MicroBatcher", "ServingOverloadError"]
 
@@ -40,19 +69,25 @@ _QUEUE_DEPTH = _metrics.REGISTRY.gauge(
 
 
 class ServingOverloadError(RuntimeError):
-    """The bounded request queue stayed full past the submit timeout."""
+    """Admission refused: the bounded queue stayed full past the submit
+    timeout, or the projected queue wait exceeds the deadline budget."""
 
 
 class _WorkItem:
-    __slots__ = ("feed", "future", "t_submit")
+    __slots__ = ("feed", "future", "t_submit", "deadline")
 
-    def __init__(self, feed):
+    def __init__(self, feed, deadline=None):
         self.feed = feed
         self.future = Future()
         self.t_submit = time.perf_counter()
+        self.deadline = deadline  # absolute time.monotonic(), or None
 
 
 _STOP = object()
+
+# EWMA smoothing for observed queue waits (~ the last ten batches
+# dominate, so the projection tracks load swings without flapping).
+_WAIT_ALPHA = 0.2
 
 
 def _resolve(future, result=None, exception=None):
@@ -89,6 +124,8 @@ class MicroBatcher:
         self._q = queue.Queue(maxsize=max_queue)
         self._thread = None
         self._closed = False
+        self._wait_ewma = 0.0  # seconds an item recently waited queued
+        self._submit_seq = itertools.count()  # atomic under the GIL
         if autostart:
             self.start()
 
@@ -102,22 +139,97 @@ class MicroBatcher:
             self._thread.start()
         return self
 
-    def submit(self, feed, timeout=None):
-        """Enqueue one example; returns a Future of its outputs. Blocks
-        while the queue is full; with ``timeout`` (seconds) raises
-        :class:`ServingOverloadError` instead."""
+    def _validate(self, name, a):
+        """Reject a malformed example at the door (its caller alone),
+        instead of letting np.stack/XLA fail the whole coalesced batch
+        it would have ridden in."""
+        spec = self.engine._feed_specs.get(name)
+        if spec is None:
+            return
+        dims = tuple(spec[0][1:])  # per-example dims, batch dim dropped
+        if len(a.shape) != len(dims) or any(
+                d >= 0 and s != d for d, s in zip(dims, a.shape)):
+            raise ValueError(
+                "feed %r: example shape %s does not match the model's "
+                "per-example spec %s (submit() takes ONE example, "
+                "without the batch dim)" % (name, a.shape, dims))
+        if a.dtype.kind in "OSUV":  # object/str/void: poison for XLA
+            raise ValueError(
+                "feed %r: example dtype %s is not numeric (model "
+                "expects %s)" % (name, a.dtype, spec[1]))
+
+    def submit(self, feed, timeout=None, deadline_ms=None):
+        """Enqueue one example; returns a Future of its outputs.
+
+        ``deadline_ms``: serve-by budget from now (default: the
+        ``serving_deadline_ms`` flag; 0/None = no deadline). An already
+        hopeless submit is refused synchronously —
+        :class:`ServingOverloadError` when the projected queue wait
+        exceeds the budget, :class:`ServingDeadlineError` when the
+        budget is gone — and an item whose deadline passes while queued
+        resolves its Future with :class:`ServingDeadlineError` without
+        reaching a device. ``timeout``: seconds to wait on a full
+        queue; raises :class:`ServingOverloadError` instead of blocking
+        forever."""
         if self._closed:
             raise RuntimeError("batcher is closed")
+        seq = next(self._submit_seq)
+        try:
+            _faults.fire_point("serving_overload", index=seq,
+                               default_exc=ServingOverloadError)
+        except ServingOverloadError:
+            _sres.SHED.inc()
+            raise
+        if deadline_ms is None:
+            deadline_ms = _config.get_flag("serving_deadline_ms")
+        deadline = None
+        if deadline_ms:  # 0/None = no deadline, per the contract
+            budget = float(deadline_ms) / 1e3
+            if budget < 0:
+                _sres.DEADLINE_EXCEEDED.inc()
+                raise ServingDeadlineError(
+                    "deadline budget %.1f ms already spent"
+                    % float(deadline_ms))
+            projected = self._wait_ewma * (
+                1.0 + self._q.qsize() / float(self.max_batch))
+            if projected > budget:
+                # Decay the estimate on every shed: only dispatched
+                # items update the EWMA, so without this a congestion
+                # spike would latch it high on an idle queue and shed
+                # deadline traffic forever. Geometric decay re-admits
+                # a probe request within a few sheds, and its REAL
+                # observed wait re-anchors the estimate.
+                self._wait_ewma *= (1.0 - _WAIT_ALPHA)
+                _sres.SHED.inc()
+                raise ServingOverloadError(
+                    "shed: projected queue wait %.1f ms exceeds the "
+                    "%.1f ms deadline budget"
+                    % (projected * 1e3, budget * 1e3))
+            deadline = time.monotonic() + budget
         if isinstance(feed, (list, tuple)):
             feed = dict(zip(self.engine.feed_names, feed))
-        item = _WorkItem({n: np.asarray(feed[n])
-                          for n in self.engine.feed_names})
+        arrays = {}
+        for name in self.engine.feed_names:
+            a = np.asarray(feed[name])
+            self._validate(name, a)
+            arrays[name] = a
+        item = _WorkItem(arrays, deadline=deadline)
         try:
             self._q.put(item, block=True, timeout=timeout)
         except queue.Full:
             raise ServingOverloadError(
                 "serving queue full (%d pending)" % self._q.qsize()) \
                 from None
+        if self._closed and self._thread is None:
+            # Raced a close()/drain() past its leftover sweep: nothing
+            # may ever pop this item, so fail OUR future (idempotent —
+            # the shutdown sweep may have raced us to it, and _resolve
+            # makes a later pop by drain a no-op) and refuse the
+            # submit. Only ours: a concurrent drain() still owns and
+            # serves every other accepted item.
+            _resolve(item.future,
+                     exception=RuntimeError("batcher closed"))
+            raise RuntimeError("batcher is closed")
         _QUEUE_DEPTH.set(self._q.qsize())
         return item.future
 
@@ -153,12 +265,43 @@ class MicroBatcher:
                 return
 
     def _flush(self, batch):
+        """Dispatch a gathered batch: drop expired items, then run each
+        same-shape group as one engine execution (mixed shapes — only
+        possible for feeds with dynamic per-example dims — batch
+        separately instead of failing each other)."""
+        now = time.monotonic()
+        live = []
+        for it in batch:
+            if it.deadline is not None and now >= it.deadline:
+                _sres.DEADLINE_EXCEEDED.inc()
+                _resolve(it.future, exception=ServingDeadlineError(
+                    "deadline expired after %.1f ms in queue"
+                    % ((time.perf_counter() - it.t_submit) * 1e3)))
+            else:
+                wait = time.perf_counter() - it.t_submit
+                self._wait_ewma += _WAIT_ALPHA * (wait - self._wait_ewma)
+                live.append(it)
+        if not live:
+            return
+        names = self.engine.feed_names
+        groups = {}
+        for it in live:
+            # dtype is part of the key: a stray float64/int64 example
+            # batches alone instead of upcasting (and poisoning) the
+            # whole stacked group
+            key = tuple((it.feed[n].shape, it.feed[n].dtype)
+                        for n in names)
+            groups.setdefault(key, []).append(it)
+        for group in groups.values():
+            self._flush_group(group)
+
+    def _flush_group(self, batch):
         try:
             with _tracing.span("servingBatch", size=len(batch)):
                 feed = {name: np.stack([it.feed[name] for it in batch])
                         for name in self.engine.feed_names}
                 outs = self.engine.run(feed)
-        except Exception as exc:  # mismatched shapes, engine failure, ...
+        except Exception as exc:  # engine failure, every replica down...
             for it in batch:
                 _resolve(it.future, exception=exc)
             return
@@ -170,26 +313,55 @@ class MicroBatcher:
             _REQUEST_SECONDS.observe(now - it.t_submit)
 
     # -- lifecycle -------------------------------------------------------
-    def close(self, timeout=5.0):
-        """Drain-and-stop: queued requests before the stop marker still
-        complete; subsequent submits raise."""
-        if self._closed:
-            return
+    def _stop_dispatcher(self, timeout):
+        """Common close/drain step: mark closed, wake the dispatcher
+        with a stop marker, join it. Returns the items left in the
+        queue (racing submits that landed behind the marker). A
+        dispatcher wedged mid-batch is disowned, but the queue is
+        still emptied — each item is popped exactly once, so the
+        caller fails/serves what it got and the wedged thread serves
+        only what it already held."""
         self._closed = True
         if self._thread is not None:
-            self._q.put(_STOP)
+            try:
+                # never block on a full queue behind a wedged
+                # dispatcher — with the marker unplaceable, the sweep
+                # below empties the queue and the dispatcher's get loop
+                # exits on empty+closed anyway
+                self._q.put_nowait(_STOP)
+            except queue.Full:
+                pass
             self._thread.join(timeout)
             self._thread = None
-        # A submit() racing close() can land behind the stop marker;
-        # fail those futures rather than leave result() hanging forever.
+        leftovers = []
         while True:
             try:
                 item = self._q.get_nowait()
             except queue.Empty:
                 break
             if item is not _STOP:
-                _resolve(item.future,
-                         exception=RuntimeError("batcher closed"))
+                leftovers.append(item)
+        return leftovers
+
+    def drain(self, timeout=None):
+        """Graceful drain (the redeploy story): stop admission, serve
+        every request already accepted — including submits that raced
+        the stop marker — and stop the dispatcher. Every accepted
+        Future resolves; afterwards the process holds no queued work
+        and a fresh batcher/engine can take over."""
+        leftovers = self._stop_dispatcher(timeout)
+        for i in range(0, len(leftovers), self.max_batch):
+            self._flush(leftovers[i:i + self.max_batch])
+        _QUEUE_DEPTH.set(0)
+
+    def close(self, timeout=5.0):
+        """Drain-and-stop with a bounded wait: queued requests before
+        the stop marker still complete; anything after it is failed
+        rather than left hanging; subsequent submits raise."""
+        for item in self._stop_dispatcher(timeout):
+            _resolve(item.future,
+                     exception=RuntimeError("batcher closed"))
+        _QUEUE_DEPTH.set(0)
 
     def __enter__(self):
         return self.start()

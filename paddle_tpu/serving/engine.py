@@ -17,6 +17,24 @@ JAX/XLA idioms:
   behind a lock (the jitted computation itself is thread-safe, the
   lock keeps per-replica HBM traffic ordered).
 
+Resilience (``serving/resilience.py``): each replica can be wrapped in
+a circuit breaker (``breaker_failures`` arg or the
+``serving_breaker_failures`` flag) — N consecutive execution failures,
+or a single hang past the per-call ``timeout``, open the breaker and
+quarantine the replica out of round-robin; failed requests re-dispatch
+to the next healthy replica (``paddle_serving_failover_total``), and a
+background half-open probe re-runs a warmed bucket to re-admit the
+replica after ``breaker_cooldown_ms``. ``run`` accepts an absolute
+``deadline`` (or relative ``deadline_ms``) rejected *before* dispatch,
+and ``close()`` makes the engine refuse new work (the graceful-drain
+story, with ``MicroBatcher.drain()``). With the flags at their
+defaults none of this is constructed and ``run`` costs three ``None``
+checks over the PR-2 path.
+
+Fault-injection sites (resilience/faults.py, chaos-testable):
+``serving_replica_fail`` / ``serving_replica_slow``, both indexed by
+replica number.
+
 Quantized artifacts (``io.save_inference_model(..., quantize="int8")``)
 load transparently — dequantization happens in ``load_inference_model``
 — so the same engine serves f32 and int8 exports.
@@ -26,8 +44,13 @@ Metrics (always on — the front door is not a per-op hot path):
 {bucket}, ``paddle_serving_batch_occupancy``,
 ``paddle_serving_batch_seconds``{bucket},
 ``paddle_serving_bucket_compiles_total``{bucket},
-``paddle_serving_bucket_overflow_total``. Host spans (``servingRun``)
-flow to the Chrome trace when the ``telemetry`` flag is armed.
+``paddle_serving_bucket_overflow_total``, plus the resilience families
+(``paddle_serving_failover_total``,
+``paddle_serving_breaker_transitions_total``{state},
+``paddle_serving_replica_healthy``{replica},
+``paddle_serving_deadline_exceeded_total``). Host spans
+(``servingRun``) flow to the Chrome trace when the ``telemetry`` flag
+is armed.
 """
 
 import itertools
@@ -44,6 +67,10 @@ from ..core.executor import Executor
 from ..core.scope import Scope
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
+from ..resilience import faults as _faults
+from . import resilience as _sres
+from .resilience import (BreakerProbe, ReplicaBreaker, ServingDeadlineError,
+                         ServingTimeoutError, ServingUnavailableError)
 
 __all__ = ["ServingEngine"]
 
@@ -68,15 +95,24 @@ _OVERFLOWS = _metrics.REGISTRY.counter(
     "Requests larger than the biggest bucket (served unpadded)")
 
 
-class _Replica:
-    __slots__ = ("exe", "scope", "device", "lock", "seen")
+# distinguishes per-replica health gauges when several breaker-armed
+# engines share the process-global metric registry
+_ENGINE_SEQ = itertools.count()
 
-    def __init__(self, exe, scope, device):
+
+class _Replica:
+    __slots__ = ("index", "exe", "scope", "device", "lock", "seen",
+                 "stuck", "guard")
+
+    def __init__(self, index, exe, scope, device):
+        self.index = index
         self.exe = exe
         self.scope = scope
         self.device = device
         self.lock = threading.Lock()
         self.seen = set()  # feed signatures already compiled here
+        self.stuck = None  # done-Event of a timed-out worker, if any
+        self.guard = threading.Lock()  # serializes stuck bookkeeping
 
 
 class ServingEngine:
@@ -86,10 +122,17 @@ class ServingEngine:
     single-file model. ``buckets`` defaults to the ``serving_buckets``
     config flag. ``replicas`` > 1 copies the weights onto that many
     devices (round-robin over ``jax.devices()``) and fans requests out.
+
+    ``breaker_failures`` / ``breaker_cooldown_ms`` (default: the
+    ``serving_breaker_*`` flags; 0 failures = breakers off) arm the
+    per-replica circuit breakers. ``timeout`` (seconds) is the default
+    per-call execution timeout enforced around every dispatch — a hang
+    past it opens the replica's breaker immediately.
     """
 
     def __init__(self, model_dir, buckets=None, replicas=1, devices=None,
-                 warmup=True, place=None):
+                 warmup=True, place=None, breaker_failures=None,
+                 breaker_cooldown_ms=None, timeout=None):
         if buckets is None:
             buckets = _config.get_flag("serving_buckets")
         self.buckets = tuple(sorted({int(b) for b in buckets}))
@@ -115,7 +158,7 @@ class ServingEngine:
             devices = [devs[i % len(devs)] for i in range(replicas)]
         self.replicas = []
         if not devices:
-            self.replicas.append(_Replica(exe0, scope0, None))
+            self.replicas.append(_Replica(0, exe0, scope0, None))
         else:
             host = {n: np.asarray(v) for n, v in scope0.items()}
             for i, dev in enumerate(devices):
@@ -123,8 +166,30 @@ class ServingEngine:
                 for n, v in host.items():
                     scope.set_var(n, jax.device_put(v, dev))
                 exe = exe0 if i == 0 else Executor(place=place)
-                self.replicas.append(_Replica(exe, scope, dev))
+                self.replicas.append(_Replica(i, exe, scope, dev))
         self._rr = itertools.count()
+        self._closed = False
+        self._engine_id = next(_ENGINE_SEQ)
+
+        if breaker_failures is None:
+            breaker_failures = _config.get_flag("serving_breaker_failures")
+        if breaker_cooldown_ms is None:
+            breaker_cooldown_ms = _config.get_flag(
+                "serving_breaker_cooldown_ms")
+        self.default_timeout = timeout
+        if breaker_failures:
+            self._breakers = [
+                ReplicaBreaker(rep.index, breaker_failures,
+                               float(breaker_cooldown_ms) / 1e3,
+                               label="e%d:%d" % (self._engine_id,
+                                                 rep.index))
+                for rep in self.replicas]
+        else:
+            self._breakers = None
+        self._probe = None           # BreakerProbe, started lazily
+        self._probe_feed = None      # (feed dict, bucket) from warmup
+        self._probe_lock = threading.Lock()
+
         if warmup:
             self.warmup()
 
@@ -140,23 +205,84 @@ class ServingEngine:
         return None
 
     def _execute(self, rep, feed, bucket):
+        _faults.fire_point("serving_replica_fail", index=rep.index)
         sig = tuple(sorted((n, a.shape) for n, a in feed.items()))
-        if sig not in rep.seen:
-            rep.seen.add(sig)
-            _BUCKET_COMPILES.labels(bucket=bucket).inc()
         if rep.device is not None:
             feed = {n: jax.device_put(a, rep.device)
                     for n, a in feed.items()}
         with rep.lock, _tracing.span("servingRun", bucket=bucket):
-            return rep.exe.run(self.program, feed=feed,
+            _faults.fire_point("serving_replica_slow", index=rep.index)
+            outs = rep.exe.run(self.program, feed=feed,
                                fetch_list=self.fetch_names,
                                scope=rep.scope)
+            # Only after a successful run: a failed first execution must
+            # not suppress the compile counter for the real compile that
+            # happens on the next (successful) attempt.
+            if sig not in rep.seen:
+                rep.seen.add(sig)
+                _BUCKET_COMPILES.labels(bucket=bucket).inc()
+        return outs
 
-    def run(self, feed):
-        """Serve one batch: pads to the nearest bucket, dispatches to the
-        next replica, slices outputs back to the real batch size.
-        ``feed``: {name: array} or positional list; arrays are
-        batch-major. Thread-safe."""
+    def _execute_timed(self, rep, feed, bucket, timeout):
+        """Run ``_execute`` bounded by ``timeout`` seconds. One worker
+        thread is spawned per timed dispatch — ~e-5 s against ms-scale
+        batch executions (measured within noise, PROFILE.md round 9),
+        and the simplest structure that survives a wedged run: a hung
+        device execution can't be cancelled, so it is left to finish on
+        its worker thread while the caller gets ServingTimeoutError — the
+        breaker quarantines the replica (whose lock the hung run still
+        holds) out of rotation. While that earlier worker is still
+        wedged, fail fast instead of stacking another blocked thread
+        (and its pinned feed arrays) behind the same lock — probes
+        against a wedged replica would otherwise leak one thread per
+        cooldown."""
+        with rep.guard:
+            prior = rep.stuck
+            if prior is not None:
+                if prior.is_set():
+                    rep.stuck = None  # the old run finally finished
+                else:
+                    raise ServingTimeoutError(
+                        "replica %d still wedged in an earlier "
+                        "execution" % rep.index)
+        result = {}
+        done = threading.Event()
+
+        def work():
+            try:
+                result["outs"] = self._execute(rep, feed, bucket)
+            except BaseException as exc:
+                result["exc"] = exc
+            finally:
+                done.set()
+
+        worker = threading.Thread(target=work, daemon=True,
+                                  name="serving-exec-%d" % rep.index)
+        worker.start()
+        if not done.wait(timeout):
+            with rep.guard:
+                # keep the FIRST still-unset marker: concurrent timed
+                # calls must not overwrite it with a later one
+                if rep.stuck is None or rep.stuck.is_set():
+                    rep.stuck = done
+            raise ServingTimeoutError(
+                "replica %d exceeded the %.3fs execution timeout"
+                % (rep.index, timeout))
+        if "exc" in result:
+            raise result["exc"]
+        return result["outs"]
+
+    def _run_once(self, rep, arrays, bucket, timeout):
+        t0 = time.perf_counter()
+        if timeout is not None:
+            outs = self._execute_timed(rep, arrays, bucket, timeout)
+        else:
+            outs = self._execute(rep, arrays, bucket)
+        _BATCH_SECONDS.labels(bucket=bucket).observe(
+            time.perf_counter() - t0)
+        return outs
+
+    def _prepare(self, feed):
         if isinstance(feed, (list, tuple)):
             feed = dict(zip(self.feed_names, feed))
         arrays = {}
@@ -183,12 +309,9 @@ class ServingEngine:
             arrays = {name: np.concatenate(
                 [a, np.zeros((bucket - n,) + a.shape[1:], a.dtype)])
                 for name, a in arrays.items()}
+        return arrays, n, bucket
 
-        rep = self.replicas[next(self._rr) % len(self.replicas)]
-        t0 = time.perf_counter()
-        outs = self._execute(rep, arrays, bucket)
-        _BATCH_SECONDS.labels(bucket=bucket).observe(
-            time.perf_counter() - t0)
+    def _finish(self, outs, n, bucket):
         _REQUESTS.inc(n)
         _BATCHES.labels(bucket=bucket).inc()
         _OCCUPANCY.set(n / float(bucket))
@@ -196,13 +319,177 @@ class ServingEngine:
                 if getattr(o, "ndim", 0) > 0 and o.shape[0] == bucket
                 else np.asarray(o) for o in outs]
 
+    def _candidates(self):
+        """Replica indices to try, in round-robin order. Healthy
+        (breaker-closed) replicas only; when NONE is healthy, replicas
+        whose cooldown has elapsed (or that are already half-open) are
+        offered as trial dispatches — the traffic itself becomes the
+        probe."""
+        start = next(self._rr)
+        n = len(self.replicas)
+        order = [(start + i) % n for i in range(n)]
+        if self._breakers is None:
+            return order
+        closed = [i for i in order if self._breakers[i].state == "closed"]
+        now = time.monotonic()
+        if closed:
+            if self._probe is None:
+                # No background prober (no warmed bucket to re-run):
+                # live traffic is the only re-admission path, so lead
+                # with ONE probe-ready replica as the trial — the
+                # healthy replicas behind it absorb a failed trial via
+                # failover, and success re-admits it. Without this a
+                # half-open replica would be stranded out of rotation
+                # as soon as any other replica recovers.
+                for i in order:
+                    breaker = self._breakers[i]
+                    if breaker.state == "half_open" \
+                            or breaker.ready_to_probe(now):
+                        breaker.to_half_open()
+                        return [i] + closed  # i is not closed, no dedup
+            return closed
+        trial = []
+        for i in order:
+            breaker = self._breakers[i]
+            if breaker.state == "half_open" or breaker.ready_to_probe(now):
+                breaker.to_half_open()
+                trial.append(i)
+        return trial
+
+    def run(self, feed, timeout=None, deadline=None, deadline_ms=None):
+        """Serve one batch: pads to the nearest bucket, dispatches to the
+        next healthy replica, slices outputs back to the real batch
+        size. ``feed``: {name: array} or positional list; arrays are
+        batch-major. Thread-safe.
+
+        ``timeout``: seconds to bound THIS execution (defaults to the
+        engine's ``timeout``); a hang raises ServingTimeoutError and
+        opens the replica's breaker. ``deadline``: absolute
+        ``time.monotonic()`` deadline (or ``deadline_ms`` relative to
+        now) checked *before* dispatch — an expired request raises
+        ServingDeadlineError without ever occupying a device. On an
+        execution failure the request fails over to the next healthy
+        replica; it only raises when no replica can take it."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        if timeout is None:
+            timeout = self.default_timeout
+        if deadline is None and deadline_ms:  # 0/None = no deadline
+            deadline = time.monotonic() + float(deadline_ms) / 1e3
+        if deadline is not None and time.monotonic() >= deadline:
+            # already doomed: refuse before the padding copies and
+            # before touching round-robin/breaker state
+            _sres.DEADLINE_EXCEEDED.inc()
+            raise ServingDeadlineError("deadline expired before dispatch")
+        arrays, n, bucket = self._prepare(feed)
+
+        if self._breakers is None and timeout is None and deadline is None:
+            # PR-2 healthy fast path: no resilience bookkeeping at all.
+            rep = self.replicas[next(self._rr) % len(self.replicas)]
+            outs = self._run_once(rep, arrays, bucket, None)
+            return self._finish(outs, n, bucket)
+
+        candidates = self._candidates()
+        if not candidates:
+            raise ServingUnavailableError(
+                "no healthy replica (all %d breakers open)"
+                % len(self.replicas))
+        last_exc = None
+        charged = False  # a breaker already blamed for THIS request
+        for pos, idx in enumerate(candidates):
+            if deadline is not None and time.monotonic() >= deadline:
+                _sres.DEADLINE_EXCEEDED.inc()
+                raise ServingDeadlineError(
+                    "deadline expired before dispatch")
+            rep = self.replicas[idx]
+            breaker = self._breakers[idx] if self._breakers else None
+            try:
+                outs = self._run_once(rep, arrays, bucket, timeout)
+            except Exception as exc:
+                last_exc = exc
+                if breaker is None:
+                    raise
+                hang = isinstance(exc, ServingTimeoutError)
+                # A request that already failed on another replica is
+                # almost certainly poison (bad feed content) — charge
+                # at most ONE breaker per request so a few bad requests
+                # can't open every breaker and black out healthy
+                # replicas. Hangs are always the replica's fault, and a
+                # half-open trial failure must always record (a breaker
+                # left dangling in half_open would never be probed or
+                # dispatched to again once another replica recovers).
+                if hang or not charged or breaker.state == "half_open":
+                    breaker.record_failure(hang=hang)
+                    charged = True
+                self._ensure_probe()
+                if pos + 1 == len(candidates):
+                    raise
+                _sres.FAILOVER.inc()
+                continue
+            if breaker is not None:
+                breaker.record_success()
+            return self._finish(outs, n, bucket)
+        raise last_exc  # pragma: no cover (loop always returns/raises)
+
+    # -- resilience ------------------------------------------------------
+    def _ensure_probe(self):
+        """Start the background half-open prober the first time any
+        breaker opens (needs a warmed bucket to re-execute; without
+        warmup, re-admission falls back to trial dispatches)."""
+        if self._probe is not None or self._probe_feed is None:
+            return
+        with self._probe_lock:
+            if self._probe is None and not self._closed:
+                probe = BreakerProbe(self._breakers, self._probe_replica)
+                probe.start()
+                self._probe = probe
+
+    def _probe_replica(self, index):
+        feed, bucket = self._probe_feed
+        timeout = self.default_timeout
+        if timeout is None:
+            timeout = max(30.0, *(b.cooldown for b in self._breakers))
+        self._execute_timed(self.replicas[index], feed, bucket, timeout)
+
+    def replica_health(self):
+        """Breaker state per replica ('closed' = in rotation); all
+        'closed' when breakers are disarmed."""
+        if self._breakers is None:
+            return ["closed"] * len(self.replicas)
+        return [b.state for b in self._breakers]
+
+    def close(self):
+        """Refuse new work and stop the probe thread. In-flight runs
+        finish; the process is left cleanly restartable (a new engine
+        over the same export rebuilds everything)."""
+        with self._probe_lock:  # vs a racing _ensure_probe start
+            self._closed = True
+            probe, self._probe = self._probe, None
+        if probe is not None:
+            probe.stop()
+        if self._breakers is not None:
+            for breaker in self._breakers:
+                # drop this engine's health gauge children so redeploy
+                # cycles don't accumulate stale per-engine labels;
+                # retire first so a straggling probe/run can't
+                # resurrect the child
+                breaker.retired = True
+                _sres.REPLICA_HEALTHY.remove(replica=breaker.label)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
     # -- startup ---------------------------------------------------------
     def warmup(self, example_feed=None):
         """Compile every bucket on every replica ahead of traffic.
         Feature dims come from the program's feed vars; a model with
         dynamic (non-batch) dims needs ``example_feed`` — one example
         per feed name, WITHOUT the batch dim. Returns the warmed
-        buckets."""
+        buckets. The smallest warmed bucket also becomes the breaker
+        probe's health-check execution."""
         warmed = []
         for b in self.buckets:
             feed = {}
@@ -220,5 +507,7 @@ class ServingEngine:
                 continue
             for rep in self.replicas:
                 self._execute(rep, feed, b)
+            if not warmed:
+                self._probe_feed = (feed, b)
             warmed.append(b)
         return warmed

@@ -1,0 +1,197 @@
+"""Serving-resilience primitives: errors, replica circuit breakers,
+and the half-open probe loop.
+
+PR 3 gave *training* a designed recovery path; this module extends the
+same discipline to the serving tier (the component the ROADMAP north
+star says must "serve heavy traffic from millions of users"), where
+the failure shapes are different:
+
+* a request whose **deadline** has already passed is doomed work — it
+  must be dropped before it occupies a device, not after
+  (:class:`ServingDeadlineError`);
+* a **wedged or failing replica** must be quarantined out of dispatch
+  instead of poisoning round-robin forever
+  (:class:`ReplicaBreaker`: closed -> open on N consecutive failures
+  or a single hang, -> half_open after a cooldown, -> closed when a
+  probe execution succeeds — :class:`BreakerProbe` re-runs a warmed
+  bucket in the background);
+* **overload** should shed early, while the deadline budget can still
+  be honoured elsewhere, rather than time every caller out at the
+  worst moment (:class:`ServingOverloadError` — raised by the
+  batcher's queue-wait EWMA admission check).
+
+Everything here is always-on metric-wise (recovery you can't see is
+recovery you can't trust — the PR-3 rule): transitions, failovers,
+sheds and deadline kills flow through the observability registry
+unconditionally; the *mechanisms* are armed per engine/request, so the
+default healthy path stays one flag check per request.
+"""
+
+import threading
+import time
+
+from ..observability import metrics as _metrics
+from ..utils import log as _log
+
+__all__ = ["ServingDeadlineError", "ServingTimeoutError",
+           "ServingUnavailableError", "ReplicaBreaker", "BreakerProbe"]
+
+DEADLINE_EXCEEDED = _metrics.REGISTRY.counter(
+    "paddle_serving_deadline_exceeded_total",
+    "Requests resolved with ServingDeadlineError (dropped before or "
+    "rejected at dispatch)")
+SHED = _metrics.REGISTRY.counter(
+    "paddle_serving_shed_total",
+    "Requests shed at admission (projected queue wait exceeded the "
+    "deadline budget, or injected overload)")
+FAILOVER = _metrics.REGISTRY.counter(
+    "paddle_serving_failover_total",
+    "Requests re-dispatched to another replica after an execution "
+    "failure or hang")
+BREAKER_TRANSITIONS = _metrics.REGISTRY.counter(
+    "paddle_serving_breaker_transitions_total",
+    "Replica circuit-breaker state entries", labelnames=("state",))
+REPLICA_HEALTHY = _metrics.REGISTRY.gauge(
+    "paddle_serving_replica_healthy",
+    "1 while the replica's breaker is closed (in dispatch rotation)",
+    labelnames=("replica",))
+
+
+class ServingDeadlineError(RuntimeError):
+    """The request's absolute deadline passed before it was served."""
+
+
+class ServingTimeoutError(RuntimeError):
+    """A replica execution exceeded the per-call timeout (hang)."""
+
+
+class ServingUnavailableError(RuntimeError):
+    """Every replica's breaker is open — nothing healthy to dispatch to."""
+
+
+class ReplicaBreaker:
+    """Per-replica circuit breaker.
+
+    ``closed`` (healthy, in rotation) -> ``open`` after ``threshold``
+    CONSECUTIVE execution failures, or immediately on a single hang
+    past the execution timeout (a wedged device is not worth N more
+    co-batched victims). ``open`` -> ``half_open`` once ``cooldown``
+    seconds have passed (via :meth:`to_half_open`, driven by the
+    background :class:`BreakerProbe` or by a trial dispatch when no
+    replica is healthy). ``half_open`` -> ``closed`` on the next
+    success, back to ``open`` on the next failure (cooldown restarts).
+
+    A success in any state resets the consecutive-failure count: the
+    threshold distinguishes a dying replica from isolated glitches,
+    exactly like the trainer's ``nonfinite_budget``.
+    """
+
+    __slots__ = ("index", "threshold", "cooldown", "state", "failures",
+                 "opened_at", "label", "retired", "_lock")
+
+    def __init__(self, index, threshold, cooldown_sec, label=None):
+        self.index = int(index)
+        self.threshold = max(1, int(threshold))
+        self.cooldown = float(cooldown_sec)
+        self.state = "closed"
+        self.failures = 0
+        self.opened_at = 0.0
+        self.retired = False  # engine closed: stop touching the gauge
+        # gauge label: engines pass "engineN:replicaI" so two
+        # breaker-armed engines in one process don't overwrite each
+        # other's health state on the shared registry
+        self.label = str(index) if label is None else str(label)
+        self._lock = threading.Lock()
+        REPLICA_HEALTHY.labels(replica=self.label).set(1)
+
+    def _transition(self, new_state):
+        self.state = new_state
+        BREAKER_TRANSITIONS.labels(state=new_state).inc()
+        if not self.retired:
+            # a straggler (disowned probe attempt, in-flight run)
+            # finishing after engine.close() must not resurrect the
+            # gauge child close() just removed
+            REPLICA_HEALTHY.labels(replica=self.label).set(
+                1 if new_state == "closed" else 0)
+        _log.structured("serving_breaker", replica=self.index,
+                        state=new_state, failures=self.failures)
+
+    def record_success(self):
+        with self._lock:
+            self.failures = 0
+            if self.state != "closed":
+                self._transition("closed")
+
+    def record_failure(self, hang=False):
+        with self._lock:
+            self.failures += 1
+            if (hang or self.state == "half_open"
+                    or self.failures >= self.threshold):
+                if self.state != "open":
+                    self._transition("open")
+                self.opened_at = time.monotonic()
+
+    def ready_to_probe(self, now=None):
+        if self.state != "open":
+            return False
+        now = time.monotonic() if now is None else now
+        return now - self.opened_at >= self.cooldown
+
+    def to_half_open(self):
+        with self._lock:
+            if self.state == "open":
+                self._transition("half_open")
+
+
+class BreakerProbe(threading.Thread):
+    """Background half-open prober: for every breaker past its cooldown,
+    transition to half_open and run ``probe_fn(replica_index)`` (the
+    engine re-executes a warmed bucket there); success re-admits the
+    replica, failure re-opens with a fresh cooldown. Daemon, started
+    lazily by the engine the first time any breaker opens."""
+
+    def __init__(self, breakers, probe_fn, interval=None):
+        super().__init__(name="serving-breaker-probe", daemon=True)
+        self.breakers = breakers
+        self.probe_fn = probe_fn
+        if interval is None:
+            # resolution scales with the cooldown being awaited (a 60 s
+            # cooldown doesn't need 20 Hz polling), floored for tests
+            # with millisecond cooldowns
+            cooldown = min((b.cooldown for b in breakers), default=1.0)
+            interval = min(1.0, max(cooldown / 8.0, 0.01))
+        self.interval = interval
+        self._stop_ev = threading.Event()
+
+    def run(self):
+        while not self._stop_ev.is_set():
+            now = time.monotonic()
+            unhealthy = False
+            for breaker in self.breakers:
+                if self._stop_ev.is_set():
+                    return
+                if breaker.state == "closed":
+                    continue
+                unhealthy = True
+                # half_open stragglers (e.g. a trial dispatch that
+                # failed without recording) are probed directly, so no
+                # state can strand a replica out of rotation forever
+                if breaker.state != "half_open" \
+                        and not breaker.ready_to_probe(now):
+                    continue
+                breaker.to_half_open()
+                try:
+                    self.probe_fn(breaker.index)
+                except Exception:
+                    breaker.record_failure()
+                else:
+                    breaker.record_success()
+            # park at a coarse tick while every breaker is healthy —
+            # the thread only needs fine resolution mid-incident
+            self._stop_ev.wait(self.interval if unhealthy
+                               else max(self.interval, 1.0))
+
+    def stop(self, join_timeout=2.0):
+        self._stop_ev.set()
+        if self.is_alive():
+            self.join(join_timeout)

@@ -1,0 +1,130 @@
+"""Subprocess serving-chaos driver (test_serving_resilience.py).
+
+Run in a fresh process (own metric registry / flag state):
+``python serving_chaos_child.py <tmpdir>``. Exports a small model,
+serves it through a 2-replica breaker-armed ServingEngine +
+MicroBatcher, lets healthy traffic flow, then kills replica 1's work
+mid-request (persistent ``serving_replica_fail`` injection) while four
+client threads keep submitting. Asserts ZERO client-visible errors —
+the healthy replica absorbs everything via failover — then lifts the
+injection and waits for the half-open probe to re-admit the replica.
+
+Prints ``RESULT {json}`` for the parent and exits 0 only if every
+invariant held.
+"""
+
+import json
+import os
+import sys
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+N_THREADS = 4
+REQS_PER_THREAD = 8
+
+
+def main():
+    tmp = sys.argv[1]
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers, io
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import MicroBatcher, ServingEngine
+
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        main_p, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main_p, startup):
+            x = layers.data("x", shape=[16])
+            h = layers.fc(x, 32, act="relu")
+            out = layers.fc(h, 10, act="softmax")
+        exe = ptpu.Executor()
+        exe.run(startup)
+        d = os.path.join(tmp, "model")
+        io.save_inference_model(d, ["x"], [out], exe,
+                                main_program=main_p)
+        feed = np.random.RandomState(0) \
+            .randn(N_THREADS * REQS_PER_THREAD, 16).astype("float32")
+        want = np.asarray(exe.run(main_p, feed={"x": feed},
+                                  fetch_list=[out])[0])
+
+    eng = ServingEngine(d, buckets=(1, 4), replicas=2, warmup=True,
+                        breaker_failures=2, breaker_cooldown_ms=150)
+    mb = MicroBatcher(eng, max_delay_ms=5.0)
+
+    # healthy traffic first, so the kill lands MID-stream
+    for i in range(4):
+        mb.submit({"x": feed[i]}).result(timeout=60)
+
+    faults.arm("serving_replica_fail", at=1, times=10_000)
+    errors = []
+    served = []
+
+    def client(tid):
+        for i in range(REQS_PER_THREAD):
+            idx = tid * REQS_PER_THREAD + i
+            try:
+                got, = mb.submit({"x": feed[idx]}).result(timeout=60)
+                np.testing.assert_allclose(got, want[idx], rtol=1e-5,
+                                           atol=1e-6)
+                served.append(idx)
+            except Exception as exc:  # any client-visible failure
+                errors.append("req %d: %r" % (idx, exc))
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    states_under_fault = eng.replica_health()
+    faults.disarm("serving_replica_fail")
+
+    import time
+    deadline = time.monotonic() + 10
+    while eng.replica_health() != ["closed", "closed"] \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    readmitted = eng.replica_health() == ["closed", "closed"]
+
+    mb.drain()
+    eng.close()
+
+    dump = metrics.REGISTRY.dump()
+
+    def counter(name, **labels):
+        for s in dump.get(name, {}).get("samples", ()):
+            if all(s["labels"].get(k) == v for k, v in labels.items()):
+                return s["value"]
+        return 0.0
+
+    result = {
+        "client_errors": len(errors),
+        "errors": errors[:5],
+        "served": len(served),
+        "expected": N_THREADS * REQS_PER_THREAD,
+        "states_under_fault": states_under_fault,
+        "failover_total": counter("paddle_serving_failover_total"),
+        "breaker_opened": counter(
+            "paddle_serving_breaker_transitions_total", state="open"),
+        "breaker_closed": counter(
+            "paddle_serving_breaker_transitions_total", state="closed"),
+        "readmitted": readmitted,
+    }
+    print("RESULT %s" % json.dumps(result), flush=True)
+    # the probe may be mid-flight when states are sampled, so the
+    # quarantined replica reads "open" or (briefly) "half_open"
+    ok = (not errors and readmitted
+          and result["failover_total"] > 0
+          and result["breaker_opened"] >= 1
+          and states_under_fault[1] != "closed")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,657 @@
+"""Serving resilience (ISSUE 5): per-request deadlines, replica
+circuit breakers with failover + half-open re-admission, adaptive load
+shedding, graceful drain, the serving fault-injection sites, and the
+off-hot-path guarantee for the default flags."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers, io
+from paddle_tpu.observability import metrics
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving import (MicroBatcher, ServingDeadlineError,
+                                ServingEngine, ServingOverloadError,
+                                ServingTimeoutError,
+                                ServingUnavailableError)
+from paddle_tpu.serving.batcher import _WorkItem
+from paddle_tpu.serving.resilience import ReplicaBreaker
+
+pytestmark = pytest.mark.serving
+
+
+def _export(tmp_path, name="model", in_dim=16, out_dim=10):
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            x = layers.data("x", shape=[in_dim])
+            h = layers.fc(x, 32, act="relu")
+            out = layers.fc(h, out_dim, act="softmax")
+        exe = ptpu.Executor()
+        exe.run(startup)
+        d = str(tmp_path / name)
+        io.save_inference_model(d, ["x"], [out], exe, main_program=main)
+        feed = np.random.RandomState(0).randn(24, in_dim) \
+            .astype("float32")
+        want, = exe.run(main, feed={"x": feed}, fetch_list=[out])
+    return d, feed, np.asarray(want)
+
+
+def _counter(name, **labels):
+    fam = metrics.REGISTRY._families.get(name)
+    if fam is None:
+        return 0.0
+    if labels:
+        return fam.labels(**labels).value
+    return fam.value
+
+
+def _count_executes(eng):
+    """Wrap eng._execute to record which replica served each call."""
+    calls = []
+    orig = eng._execute
+
+    def counting(rep, feed, bucket):
+        calls.append(rep.index)
+        return orig(rep, feed, bucket)
+
+    eng._execute = counting
+    return calls
+
+
+# -- breaker unit behavior --------------------------------------------------
+
+class TestReplicaBreaker:
+    def test_opens_after_consecutive_failures_only(self):
+        br = ReplicaBreaker(7, threshold=3, cooldown_sec=60)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()  # resets the consecutive count
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed"
+        br.record_failure()
+        assert br.state == "open"
+
+    def test_single_hang_opens_immediately(self):
+        br = ReplicaBreaker(8, threshold=5, cooldown_sec=60)
+        br.record_failure(hang=True)
+        assert br.state == "open"
+
+    def test_half_open_failure_reopens_with_fresh_cooldown(self):
+        br = ReplicaBreaker(9, threshold=1, cooldown_sec=0.01)
+        br.record_failure()
+        assert br.state == "open"
+        time.sleep(0.02)
+        assert br.ready_to_probe()
+        br.to_half_open()
+        br.record_failure()
+        assert br.state == "open" and not br.ready_to_probe()
+        time.sleep(0.02)
+        br.to_half_open()
+        br.record_success()
+        assert br.state == "closed" and br.failures == 0
+
+    def test_healthy_gauge_tracks_state(self):
+        br = ReplicaBreaker(11, threshold=1, cooldown_sec=60)
+        g = metrics.REGISTRY._families[
+            "paddle_serving_replica_healthy"].labels(replica="11")
+        assert g.value == 1
+        br.record_failure()
+        assert g.value == 0
+        br.to_half_open()  # only valid from open after cooldown; force
+        br.record_success()
+        assert g.value == 1
+
+
+# -- breaker + failover through the engine ----------------------------------
+
+@pytest.mark.chaos
+class TestBreakerFailover:
+    def test_open_failover_and_half_open_readmit(self, tmp_path):
+        """ISSUE acceptance: one of two replicas fault-injected to fail
+        persistently -> its breaker opens within N requests, serving
+        continues with zero client-visible errors and failover_total
+        grows; after the injection lifts, the background probe
+        re-admits it and round-robin resumes across both."""
+        d, feed, want = _export(tmp_path)
+        # cooldown longer than the fault phase, so the half-open probe
+        # only runs after the injection lifts (deterministic counts)
+        eng = ServingEngine(d, buckets=(4,), replicas=2, warmup=True,
+                            breaker_failures=2, breaker_cooldown_ms=400)
+        fail0 = _counter("paddle_serving_failover_total")
+        open0 = _counter("paddle_serving_breaker_transitions_total",
+                         state="open")
+        closed0 = _counter("paddle_serving_breaker_transitions_total",
+                           state="closed")
+        try:
+            faults.arm("serving_replica_fail", at=1, times=10_000)
+            for i in range(8):  # zero client-visible errors
+                got, = eng.run({"x": feed[:2]})
+                np.testing.assert_allclose(got, want[:2], rtol=1e-5,
+                                           atol=1e-6)
+            assert eng.replica_health() == ["closed", "open"]
+            assert _counter("paddle_serving_failover_total") > fail0
+            assert _counter("paddle_serving_breaker_transitions_total",
+                            state="open") >= open0 + 1
+            assert _counter("paddle_serving_replica_healthy",
+                            replica=eng._breakers[1].label) == 0
+
+            faults.disarm("serving_replica_fail")
+            deadline = time.monotonic() + 10
+            while eng.replica_health()[1] != "closed" \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert eng.replica_health() == ["closed", "closed"]
+            assert _counter("paddle_serving_breaker_transitions_total",
+                            state="closed") == closed0 + 1
+            calls = _count_executes(eng)
+            for i in range(4):  # round-robin resumed across BOTH
+                eng.run({"x": feed[:2]})
+            assert set(calls) == {0, 1}
+        finally:
+            faults.disarm()
+            eng.close()
+
+    def test_hang_past_timeout_opens_breaker_and_fails_over(self,
+                                                            tmp_path):
+        d, feed, want = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), replicas=2, warmup=True,
+                            breaker_failures=5,
+                            breaker_cooldown_ms=60_000, timeout=0.3)
+        try:
+            faults.arm("serving_replica_slow", at=1, times=1,
+                       action="callback",
+                       callback=lambda: time.sleep(1.5))
+            for i in range(2):  # one of these lands on the slow replica
+                got, = eng.run({"x": feed[:2]})
+                np.testing.assert_allclose(got, want[:2], rtol=1e-5,
+                                           atol=1e-6)
+            assert eng.replica_health()[1] == "open"  # single hang
+        finally:
+            faults.disarm()
+            eng.close()
+
+    def test_single_replica_hang_surfaces_timeout(self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True,
+                            breaker_failures=5,
+                            breaker_cooldown_ms=60_000, timeout=0.2)
+        try:
+            faults.arm("serving_replica_slow", at=0, times=1,
+                       action="callback",
+                       callback=lambda: time.sleep(1.0))
+            with pytest.raises(ServingTimeoutError):
+                eng.run({"x": feed[:2]})  # nowhere to fail over to
+            assert eng.replica_health() == ["open"]
+        finally:
+            faults.disarm()
+            eng.close()
+
+    def test_all_replicas_down_raises_unavailable(self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True,
+                            breaker_failures=1,
+                            breaker_cooldown_ms=60_000)
+        try:
+            boom = RuntimeError("device on fire")
+
+            def bad_run(*a, **k):
+                raise boom
+
+            eng.replicas[0].exe.run = bad_run
+            with pytest.raises(RuntimeError, match="device on fire"):
+                eng.run({"x": feed[:2]})  # the opening failure surfaces
+            assert eng.replica_health() == ["open"]
+            with pytest.raises(ServingUnavailableError):
+                eng.run({"x": feed[:2]})  # nothing healthy, no retry
+        finally:
+            eng.close()
+
+    def test_trial_dispatch_readmits_without_a_probe(self, tmp_path):
+        """With no warmup there is no background prober — live traffic
+        must still re-admit a quarantined replica once its cooldown
+        elapses, even while other replicas are healthy (a half-open
+        replica must never be stranded out of rotation)."""
+        d, feed, want = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), replicas=2, warmup=False,
+                            breaker_failures=1, breaker_cooldown_ms=50)
+        try:
+            assert eng._probe_feed is None  # nothing to probe with
+            # open both breakers (one failure each, charge-once means
+            # one run opens one breaker)
+            for _ in range(2):
+                faults.arm("serving_replica_fail", times=1)
+                try:
+                    eng.run({"x": feed[:2]})
+                except Exception:
+                    pass
+            time.sleep(0.08)  # past the cooldown
+            # first request trials one replica and re-admits it...
+            got, = eng.run({"x": feed[:2]})
+            np.testing.assert_allclose(got, want[:2], rtol=1e-5,
+                                       atol=1e-6)
+            # ...and with a healthy replica back, the OTHER half-open/
+            # cooled replica still gets a leading trial, not stranded
+            deadline = time.monotonic() + 5
+            while eng.replica_health() != ["closed", "closed"] \
+                    and time.monotonic() < deadline:
+                eng.run({"x": feed[:2]})
+            assert eng.replica_health() == ["closed", "closed"]
+            assert eng._probe is None  # all via trial dispatch
+        finally:
+            faults.disarm()
+            eng.close()
+
+    def test_poison_request_charges_at_most_one_breaker(self, tmp_path):
+        """A request that fails on EVERY replica is poison (bad feed
+        content), not N replica failures — it must not open every
+        breaker and black out healthy traffic."""
+        d, feed, want = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), replicas=2, warmup=True,
+                            breaker_failures=1,
+                            breaker_cooldown_ms=60_000)
+        try:
+            faults.arm("serving_replica_fail", times=2)  # any replica
+            with pytest.raises(faults.InjectedFault):
+                eng.run({"x": feed[:2]})  # fails on both replicas
+            # only the first-tried replica's breaker opened
+            assert sorted(eng.replica_health()) == ["closed", "open"]
+            got, = eng.run({"x": feed[:2]})  # service continues
+            np.testing.assert_allclose(got, want[:2], rtol=1e-5,
+                                       atol=1e-6)
+        finally:
+            faults.disarm()
+            eng.close()
+
+    def test_fail_injection_without_breakers_propagates(self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        try:
+            faults.arm("serving_replica_fail", at=0, times=1)
+            with pytest.raises(faults.InjectedFault):
+                eng.run({"x": feed[:2]})
+        finally:
+            faults.disarm()
+            eng.close()
+
+
+# -- deadlines --------------------------------------------------------------
+
+class TestDeadlines:
+    def test_expired_in_queue_never_reaches_a_device(self, tmp_path):
+        """ISSUE acceptance: a request whose deadline expires while
+        queued resolves with ServingDeadlineError without a device
+        execution, and the deadline counter increments."""
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        req0 = _counter("paddle_serving_requests_total")
+        dl0 = _counter("paddle_serving_deadline_exceeded_total")
+        mb = MicroBatcher(eng, autostart=False)
+        fut = mb.submit({"x": feed[0]}, deadline_ms=20)
+        time.sleep(0.08)  # expire while queued, dispatcher not running
+        mb.start()
+        with pytest.raises(ServingDeadlineError):
+            fut.result(timeout=10)
+        mb.close()
+        eng.close()
+        assert _counter("paddle_serving_deadline_exceeded_total") \
+            == dl0 + 1
+        # no engine execution happened for the doomed item
+        assert _counter("paddle_serving_requests_total") == req0
+
+    def test_live_deadline_is_served(self, tmp_path):
+        d, feed, want = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        with MicroBatcher(eng, max_delay_ms=5.0) as mb:
+            out, = mb.submit({"x": feed[0]},
+                             deadline_ms=30_000).result(timeout=30)
+        np.testing.assert_allclose(out, want[0], rtol=1e-5, atol=1e-6)
+        eng.close()
+
+    def test_spent_budget_rejected_synchronously(self, tmp_path):
+        d, feed, want = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        mb = MicroBatcher(eng, autostart=False)
+        with pytest.raises(ServingDeadlineError):
+            mb.submit({"x": feed[0]}, deadline_ms=-5)
+        # 0 means NO deadline (the flag default), not "already expired"
+        fut = mb.submit({"x": feed[0]}, deadline_ms=0)
+        mb.start()
+        out, = fut.result(timeout=30)
+        np.testing.assert_allclose(out, want[0], rtol=1e-5, atol=1e-6)
+        mb.close()
+        eng.close()
+
+    def test_engine_run_rejects_expired_deadline_before_dispatch(
+            self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        calls = _count_executes(eng)
+        dl0 = _counter("paddle_serving_deadline_exceeded_total")
+        with pytest.raises(ServingDeadlineError):
+            eng.run({"x": feed[:2]}, deadline=time.monotonic() - 0.01)
+        assert calls == []  # rejected before any dispatch
+        assert _counter("paddle_serving_deadline_exceeded_total") \
+            == dl0 + 1
+        eng.close()
+
+    def test_flag_default_deadline_applies(self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        mb = MicroBatcher(eng, autostart=False)
+        ptpu.config.set_flags(serving_deadline_ms=25)
+        try:
+            fut = mb.submit({"x": feed[0]})  # inherits the flag budget
+            time.sleep(0.08)
+            mb.start()
+            with pytest.raises(ServingDeadlineError):
+                fut.result(timeout=10)
+        finally:
+            ptpu.config.set_flags(serving_deadline_ms=0)
+            mb.close()
+            eng.close()
+
+
+# -- adaptive shedding ------------------------------------------------------
+
+class TestLoadShedding:
+    def test_projected_wait_beyond_budget_sheds(self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        mb = MicroBatcher(eng, autostart=False)
+        mb._wait_ewma = 1.0  # congested: recent items waited ~1s
+        shed0 = _counter("paddle_serving_shed_total")
+        with pytest.raises(ServingOverloadError, match="shed"):
+            mb.submit({"x": feed[0]}, deadline_ms=100)
+        assert _counter("paddle_serving_shed_total") == shed0 + 1
+        # a caller with budget to spare is still admitted
+        fut = mb.submit({"x": feed[0]}, deadline_ms=30_000)
+        mb.start()
+        fut.result(timeout=30)
+        mb.close()
+        eng.close()
+
+    def test_ewma_learns_from_observed_waits(self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        mb = MicroBatcher(eng, max_delay_ms=1.0, autostart=False)
+        assert mb._wait_ewma == 0.0
+        futs = [mb.submit({"x": feed[i]}) for i in range(4)]
+        time.sleep(0.03)  # the queued items age before dispatch
+        mb.start()
+        for f in futs:
+            f.result(timeout=30)
+        assert mb._wait_ewma > 0.0
+        mb.close()
+        eng.close()
+
+    def test_shedding_decays_the_estimate_and_recovers(self, tmp_path):
+        """A congestion spike must not latch the EWMA high forever:
+        consecutive sheds decay it until a probe request is admitted
+        and re-anchors it with a real observed wait."""
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        mb = MicroBatcher(eng, autostart=False)
+        mb._wait_ewma = 2.0  # stale spike; queue is now empty
+        admitted = None
+        for i in range(200):
+            try:
+                admitted = mb.submit({"x": feed[0]}, deadline_ms=500)
+                break
+            except ServingOverloadError:
+                continue
+        assert admitted is not None, "shedding never recovered"
+        assert mb._wait_ewma < 0.5
+        mb.start()
+        admitted.result(timeout=30)
+        mb.close()
+        eng.close()
+
+    def test_serving_overload_fault_site_sheds(self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        mb = MicroBatcher(eng, autostart=False)
+        shed0 = _counter("paddle_serving_shed_total")
+        try:
+            faults.arm("serving_overload", times=1)
+            with pytest.raises(ServingOverloadError):
+                mb.submit({"x": feed[0]})
+            assert _counter("paddle_serving_shed_total") == shed0 + 1
+            mb.submit({"x": feed[0]})  # next submit is admitted again
+        finally:
+            faults.disarm()
+            mb.close()
+            eng.close()
+
+
+# -- graceful drain ---------------------------------------------------------
+
+class TestDrain:
+    def test_drain_completes_all_accepted_futures(self, tmp_path):
+        d, feed, want = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        mb = MicroBatcher(eng, max_delay_ms=5.0, autostart=False)
+        futs = [mb.submit({"x": feed[i]}) for i in range(6)]
+        mb.start()
+        mb.drain()
+        for i, f in enumerate(futs):
+            out, = f.result(timeout=0.001)  # already resolved
+            np.testing.assert_allclose(out, want[i], rtol=1e-5,
+                                       atol=1e-6)
+        with pytest.raises(RuntimeError):
+            mb.submit({"x": feed[0]})
+        assert metrics.REGISTRY.gauge(
+            "paddle_serving_queue_depth").value == 0
+        eng.close()
+
+    def test_drain_without_dispatcher_serves_on_caller_thread(
+            self, tmp_path):
+        d, feed, want = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        mb = MicroBatcher(eng, autostart=False)
+        futs = [mb.submit({"x": feed[i]}) for i in range(3)]
+        mb.drain()  # thread never ran: leftovers flush synchronously
+        for i, f in enumerate(futs):
+            out, = f.result(timeout=0.001)
+            np.testing.assert_allclose(out, want[i], rtol=1e-5,
+                                       atol=1e-6)
+        eng.close()
+
+    def test_close_resets_queue_depth_gauge(self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=False)
+        mb = MicroBatcher(eng, autostart=False)
+        mb.submit({"x": feed[0]})
+        assert metrics.REGISTRY.gauge(
+            "paddle_serving_queue_depth").value == 1
+        mb.close()  # unserved future fails, gauge must not stay stale
+        assert metrics.REGISTRY.gauge(
+            "paddle_serving_queue_depth").value == 0
+        eng.close()
+
+    def test_closed_engine_refuses_work(self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        eng.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.run({"x": feed[:2]})
+
+
+# -- malformed-request isolation (satellite) --------------------------------
+
+class TestSubmitValidation:
+    def test_bad_shape_rejected_at_submit(self, tmp_path):
+        d, feed, want = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        mb = MicroBatcher(eng, autostart=False)
+        good = mb.submit({"x": feed[0]})
+        with pytest.raises(ValueError, match="per-example spec"):
+            mb.submit({"x": np.zeros(7, "float32")})  # wrong dim
+        with pytest.raises(ValueError, match="per-example spec"):
+            mb.submit({"x": feed[:2]})  # batch dim sneaked in
+        with pytest.raises(ValueError, match="not numeric"):
+            mb.submit({"x": np.array([object()] * 16)})  # XLA poison
+        mb.start()
+        out, = good.result(timeout=30)  # neighbour unaffected
+        np.testing.assert_allclose(out, want[0], rtol=1e-5, atol=1e-6)
+        mb.close()
+        eng.close()
+
+    def test_flush_isolates_mismatched_item(self, tmp_path):
+        """Even past validation (dynamic dims), a mismatched example
+        batches separately — its co-batched neighbours still serve."""
+        d, feed, want = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        mb = MicroBatcher(eng, autostart=False)
+        good = _WorkItem({"x": feed[0]})
+        bad = _WorkItem({"x": np.zeros(7, "float32")})
+        stray = _WorkItem({"x": feed[1].astype("float64")})
+        mb._flush([good, bad, stray])
+        out, = good.future.result(timeout=30)
+        np.testing.assert_allclose(out, want[0], rtol=1e-5, atol=1e-6)
+        with pytest.raises(Exception):
+            bad.future.result(timeout=30)
+        # the float64 stray batched ALONE (dtype is in the group key):
+        # whatever its own fate, it did not upcast good's batch
+        assert stray.future.done()
+        mb.close()
+        eng.close()
+
+
+# -- compile-counter satellite ----------------------------------------------
+
+class TestCompileCounter:
+    def test_failed_first_execution_does_not_hide_the_compile(
+            self, tmp_path):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=False)
+        rep = eng.replicas[0]
+        orig = rep.exe.run
+        state = {"failed": False}
+
+        def flaky(*a, **kw):
+            if not state["failed"]:
+                state["failed"] = True
+                raise RuntimeError("injected compile failure")
+            return orig(*a, **kw)
+
+        rep.exe.run = flaky
+        c0 = _counter("paddle_serving_bucket_compiles_total", bucket="4")
+        with pytest.raises(RuntimeError, match="injected"):
+            eng.run({"x": feed[:2]})
+        # the failed run must NOT mark the signature as compiled
+        assert not rep.seen
+        assert _counter("paddle_serving_bucket_compiles_total",
+                        bucket="4") == c0
+        eng.run({"x": feed[:2]})  # the real (successful) first run
+        assert len(rep.seen) == 1
+        assert _counter("paddle_serving_bucket_compiles_total",
+                        bucket="4") == c0 + 1
+        eng.close()
+
+
+# -- capi bridge inherits deadlines -----------------------------------------
+
+class TestCapiResilience:
+    def test_deadline_requires_the_bucketed_path(self):
+        from paddle_tpu import capi_bridge
+        with pytest.raises(ValueError, match="batch_buckets"):
+            capi_bridge.load_model("/nonexistent", deadline_ms=100)
+
+    def test_bucketed_forward_with_deadline(self, tmp_path):
+        from paddle_tpu import capi_bridge
+        d, feed, want = _export(tmp_path)
+        h = capi_bridge.load_model(d, batch_buckets=(4,),
+                                   deadline_ms=30_000)
+        try:
+            eng = capi_bridge._models[h]["serving"]
+            outs = capi_bridge.forward(
+                h, [("x", feed[:2].tobytes(), feed[:2].shape, 0)])
+            name, arr, shape = outs[0]
+            np.testing.assert_allclose(
+                np.frombuffer(arr, "float32").reshape(2, 10), want[:2],
+                rtol=1e-5, atol=1e-6)
+        finally:
+            capi_bridge.release(h)
+        assert eng._closed  # release stops the engine cleanly
+
+
+# -- off-hot-path guarantee -------------------------------------------------
+
+class TestOffHotPath:
+    def test_default_flags_keep_the_fast_path(self, tmp_path,
+                                              monkeypatch):
+        assert ptpu.config.get_flag("serving_breaker_failures") == 0
+        assert ptpu.config.get_flag("serving_deadline_ms") == 0
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        assert eng._breakers is None and eng._probe is None
+        monkeypatch.setattr(
+            eng, "_candidates",
+            lambda: pytest.fail("resilient dispatch on default flags"))
+        before = {n: _counter(n) for n in
+                  ("paddle_serving_deadline_exceeded_total",
+                   "paddle_serving_shed_total",
+                   "paddle_serving_failover_total")}
+        eng.run({"x": feed[:2]})
+        for name, v in before.items():
+            assert _counter(name) == v, name
+        eng.close()
+
+    def test_submit_costs_one_deadline_flag_check(self, tmp_path,
+                                                  monkeypatch):
+        d, feed, _ = _export(tmp_path)
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        mb = MicroBatcher(eng, autostart=False)
+        calls = []
+        orig = ptpu.config.get_flag
+
+        def counting(name):
+            calls.append(name)
+            return orig(name)
+
+        monkeypatch.setattr(ptpu.config, "get_flag", counting)
+        mb.submit({"x": feed[0]})
+        # exactly one serving flag check + the pre-existing
+        # fault_injection hook-site check, like telemetry
+        assert calls.count("serving_deadline_ms") == 1
+        assert set(calls) <= {"serving_deadline_ms", "fault_injection"}
+        mb.close()
+        eng.close()
+
+
+# -- subprocess chaos: replica dies mid-request -----------------------------
+
+@pytest.mark.chaos
+def test_subprocess_replica_killed_mid_request_zero_client_errors(
+        tmp_path):
+    """ISSUE satellite: a fresh process serves with 2 replicas, one
+    replica's work is killed mid-request (persistently injected
+    execution failure after traffic has started); the child asserts
+    zero client-visible errors while the healthy replica remains, that
+    the breaker opened and failover was recorded, and that lifting the
+    injection re-admits the replica."""
+    child = os.path.join(os.path.dirname(__file__),
+                         "serving_chaos_child.py")
+    proc = subprocess.run(
+        [sys.executable, child, str(tmp_path)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, \
+        "child failed:\n%s\n%s" % (proc.stdout, proc.stderr)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("RESULT ")]
+    assert lines, proc.stdout
+    import json
+    result = json.loads(lines[-1][len("RESULT "):])
+    assert result["client_errors"] == 0
+    assert result["failover_total"] > 0
+    assert result["breaker_opened"] >= 1
+    assert result["readmitted"] is True
+    assert result["served"] == result["expected"]

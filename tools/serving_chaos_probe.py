@@ -1,0 +1,180 @@
+"""Serving chaos probe: the resilience layer under injected faults,
+headless.
+
+The serving counterpart of ``tools/chaos_probe.py``: exports a small
+conv model, int8-quantizes it, serves it through a breaker-armed
+2-replica ServingEngine + MicroBatcher while TWO fault sites are hot —
+``serving_replica_fail`` (replica 1 fails persistently mid-stream) and
+``serving_overload`` (a handful of submits force-shed at admission) —
+with every request carrying a deadline. Proves, with no accelerator
+and no test harness:
+
+* zero client-visible errors beyond the injected sheds (failover
+  absorbs the dying replica),
+* the breaker opens, quarantines, and — once the injection lifts —
+  the half-open probe re-admits the replica,
+* the recovery counters and latency percentiles expose all of it.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/serving_chaos_probe.py
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+N_THREADS = 6
+REQS_PER_THREAD = 12
+N_SHEDS = 5
+BUCKETS = (1, 4, 16)
+DEADLINE_MS = 10_000.0
+
+
+def _export(tmp):
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers, io
+    from paddle_tpu.models.smallnet import smallnet
+
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        img = layers.data("img", shape=[1, 28, 28])
+        label = layers.data("label", shape=[1], dtype="int64")
+        loss, acc, logits = smallnet(img, label)
+        probs = layers.softmax(logits)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    d_int8 = os.path.join(tmp, "model_int8")
+    io.save_inference_model(d_int8, ["img"], [probs], exe,
+                            main_program=main, quantize="int8")
+    return d_int8
+
+
+def main():
+    import tempfile
+
+    import paddle_tpu as ptpu
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import (MicroBatcher, ServingEngine,
+                                    ServingOverloadError)
+
+    tmp = tempfile.mkdtemp(prefix="serving_chaos_probe_")
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        d_int8 = _export(tmp)
+
+    engine = ServingEngine(d_int8, buckets=BUCKETS, replicas=2,
+                           warmup=True, breaker_failures=2,
+                           breaker_cooldown_ms=200)
+    mb = MicroBatcher(engine, max_delay_ms=10.0)
+
+    rs = np.random.RandomState(0)
+    images = rs.randn(N_THREADS * REQS_PER_THREAD, 1, 28, 28) \
+        .astype("float32")
+
+    # healthy traffic first, so the injected failure lands mid-stream
+    for i in range(4):
+        mb.submit({"img": images[i]}).result(timeout=60)
+
+    faults.arm("serving_replica_fail", at=1, times=10_000)
+    faults.arm("serving_overload", times=N_SHEDS)
+
+    latencies, sheds, errors = [], [], []
+    lock = threading.Lock()
+
+    def client(tid):
+        for i in range(REQS_PER_THREAD):
+            idx = tid * REQS_PER_THREAD + i
+            t0 = time.perf_counter()
+            try:
+                fut = mb.submit({"img": images[idx]},
+                                deadline_ms=DEADLINE_MS)
+                fut.result(timeout=60)
+                with lock:
+                    latencies.append(time.perf_counter() - t0)
+            except ServingOverloadError:
+                with lock:
+                    sheds.append(idx)  # injected: the expected shape
+            except Exception as exc:
+                with lock:
+                    errors.append("req %d: %r" % (idx, exc))
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    states_under_fault = engine.replica_health()
+    faults.disarm("serving_replica_fail")
+    deadline = time.monotonic() + 10
+    while engine.replica_health() != ["closed", "closed"] \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    readmitted = engine.replica_health() == ["closed", "closed"]
+    faults.disarm()
+    mb.drain()
+    engine.close()
+
+    # -- report ----------------------------------------------------------
+    dump = metrics.REGISTRY.dump()
+
+    def counter(name, **labels):
+        for s in dump.get(name, {}).get("samples", ()):
+            if all(s["labels"].get(k) == v for k, v in labels.items()):
+                return s["value"]
+        return 0.0
+
+    lat_ms = np.sort(np.asarray(latencies)) * 1e3
+    pct = {p: float(lat_ms[min(int(len(lat_ms) * p / 100),
+                               len(lat_ms) - 1)])
+           for p in (50, 90, 99)}
+
+    print("== serving chaos report " + "=" * 42)
+    print(json.dumps({
+        "requests": len(latencies), "injected_sheds": len(sheds),
+        "client_errors": errors,
+        "states_under_fault": states_under_fault,
+        "readmitted": readmitted,
+        "latency_ms": {"p50": round(pct[50], 2),
+                       "p90": round(pct[90], 2),
+                       "p99": round(pct[99], 2)},
+    }, indent=1))
+    print("== recovery counters " + "=" * 45)
+    for line in metrics.REGISTRY.expose_text().splitlines():
+        if line.startswith(("paddle_serving_failover",
+                            "paddle_serving_breaker",
+                            "paddle_serving_replica_healthy",
+                            "paddle_serving_shed",
+                            "paddle_serving_deadline")):
+            print(line)
+
+    # -- smoke assertions (exit non-zero if the layer is broken) ---------
+    assert not errors, errors
+    assert len(sheds) == N_SHEDS, (len(sheds), N_SHEDS)
+    assert len(latencies) == N_THREADS * REQS_PER_THREAD - N_SHEDS
+    # "half_open" if the probe was mid-flight at sampling time; either
+    # way the replica was quarantined out of rotation
+    assert states_under_fault[1] in ("open", "half_open"), \
+        states_under_fault
+    assert counter("paddle_serving_failover_total") > 0
+    assert counter("paddle_serving_breaker_transitions_total",
+                   state="open") >= 1
+    assert counter("paddle_serving_shed_total") == N_SHEDS
+    assert readmitted, "half-open probe never re-admitted replica 1"
+    print("SERVING CHAOS PROBE OK: %d served, %d shed, failover=%d, "
+          "breaker open->closed cycle complete, p50 %.1f ms"
+          % (len(latencies), len(sheds),
+             counter("paddle_serving_failover_total"), pct[50]))
+
+
+if __name__ == "__main__":
+    main()

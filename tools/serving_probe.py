@@ -9,8 +9,15 @@ mean batch occupancy, int8-vs-f32 agreement, and the Prometheus
 exposition of the serving metric families (mirrors
 tools/telemetry_probe.py for the observability layer).
 
+With ``--resilience`` the same traffic runs with the serving-
+resilience layer armed on its healthy path — replica circuit breakers
+(``breaker_failures=3``) and a per-request deadline far above any real
+latency — so diffing the two reports measures the overhead of the
+breaker/deadline bookkeeping alone (PROFILE.md records both; target:
+within noise).
+
 Usage:
-    JAX_PLATFORMS=cpu python tools/serving_probe.py
+    JAX_PLATFORMS=cpu python tools/serving_probe.py [--resilience]
 """
 
 import json
@@ -58,12 +65,20 @@ def main():
     from paddle_tpu.observability import metrics
     from paddle_tpu.serving import ServingEngine, MicroBatcher
 
+    resilience = "--resilience" in sys.argv[1:]
     ptpu.config.set_flags(telemetry=True)
     tmp = tempfile.mkdtemp(prefix="serving_probe_")
     with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
         d_f32, d_int8 = _export(tmp)
 
-    engine = ServingEngine(d_int8, buckets=BUCKETS, warmup=True)
+    if resilience:
+        engine = ServingEngine(d_int8, buckets=BUCKETS, warmup=True,
+                               breaker_failures=3,
+                               breaker_cooldown_ms=1000)
+        deadline_ms = 60_000.0  # never binding: healthy-path overhead
+    else:
+        engine = ServingEngine(d_int8, buckets=BUCKETS, warmup=True)
+        deadline_ms = None
     ref = ServingEngine(d_f32, buckets=(REQS_PER_THREAD,), warmup=False)
 
     rs = np.random.RandomState(0)
@@ -83,7 +98,8 @@ def main():
             for i in range(REQS_PER_THREAD):
                 idx = tid * REQS_PER_THREAD + i
                 t0 = time.perf_counter()
-                fut = mb.submit({"img": images[idx]})
+                fut = mb.submit({"img": images[idx]},
+                                deadline_ms=deadline_ms)
                 out = fut.result(timeout=60)
                 with lat_lock:
                     latencies.append(time.perf_counter() - t0)
@@ -114,6 +130,7 @@ def main():
 
     print("== serving report " + "=" * 48)
     print(json.dumps({
+        "mode": "resilience" if resilience else "baseline",
         "requests": int(n_req), "batches": int(n_batches),
         "mean_batch_occupancy": round(occupancy, 2),
         "latency_ms": {"p50": round(pct[50], 2),
@@ -136,9 +153,18 @@ def main():
     warm = dump["paddle_serving_bucket_compiles_total"]["samples"]
     assert {s["labels"]["bucket"] for s in warm} >= \
         {str(b) for b in BUCKETS}, warm
-    print("SERVING PROBE OK: %d reqs, %d batches, occupancy %.2f, "
+    if resilience:  # healthy path: breakers armed but never tripped
+        assert engine.replica_health() == ["closed"], \
+            engine.replica_health()
+        for fam in ("paddle_serving_failover_total",
+                    "paddle_serving_shed_total",
+                    "paddle_serving_deadline_exceeded_total"):
+            samples = dump.get(fam, {}).get("samples", ())
+            assert all(s["value"] == 0 for s in samples), (fam, samples)
+    print("SERVING PROBE OK (%s): %d reqs, %d batches, occupancy %.2f, "
           "p50 %.1f ms, agreement %.2f"
-          % (n_req, n_batches, occupancy, pct[50], agree))
+          % ("resilience" if resilience else "baseline", n_req,
+             n_batches, occupancy, pct[50], agree))
 
 
 if __name__ == "__main__":
