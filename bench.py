@@ -540,6 +540,67 @@ def _isolated(fn):
     return out
 
 
+def bench_elastic_resume():
+    """Measure the elastic control plane's recovery latency on this
+    host: a registered peer goes silent, the master declares it dead
+    (heartbeat deadline), and a live worker re-registers at G+1 and
+    restores a small digest-verified checkpoint — the detect+restore
+    half of a lost-host recovery (the full kill-to-resumed-step number
+    comes from tools/multihost_chaos_probe.py). Returns seconds."""
+    import tempfile
+    import time as _time
+
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+    from paddle_tpu.distributed import (GenerationMismatch,
+                                        MasterClient, MasterServer)
+
+    tmp = tempfile.mkdtemp(prefix="bench_elastic_")
+    hb_timeout_ms = 400
+    srv = MasterServer(os.path.join(tmp, "snap"), timeout_sec=30,
+                       heartbeat_timeout_ms=hb_timeout_ms)
+    try:
+        with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+            main, startup = ptpu.Program(), ptpu.Program()
+            with ptpu.program_guard(main, startup):
+                x = layers.data("x", shape=[64])
+                h = layers.fc(x, 256)
+                loss = layers.mean(layers.fc(h, 1))
+            exe = ptpu.Executor()
+            exe.run(startup)
+            from paddle_tpu import io as pio
+            pio.save_checkpoint(exe, os.path.join(tmp, "ckpt"), 1, main)
+
+            # doomed first: a new member joining a non-empty cluster
+            # bumps the generation, so registering it second would
+            # fence "live" immediately and fake an instant detection
+            MasterClient(srv.port).register("doomed")  # never beats
+            c = MasterClient(srv.port)
+            gen, _ = c.register("live")
+            t0 = _time.perf_counter()
+            # beat until the master declares "doomed" dead
+            while True:
+                try:
+                    c.heartbeat("live", gen)
+                except GenerationMismatch:
+                    break
+                _time.sleep(0.02)
+                if _time.perf_counter() - t0 > 30:
+                    raise RuntimeError("master never reaped the "
+                                       "silent worker")
+            new_gen, _ = c.register("live")
+            assert new_gen == gen + 1
+            step = pio.load_checkpoint(exe, os.path.join(tmp, "ckpt"),
+                                       main)
+            assert step == 1
+            elapsed = _time.perf_counter() - t0
+        # subtract nothing: the number includes the deadline wait — the
+        # honest floor of any heartbeat-based detection
+        return elapsed, hb_timeout_ms
+    finally:
+        srv.stop()
+
+
 def main_multichip(n_devices):
     """Multi-chip dry run with a guaranteed tail: dryrun_multichip
     ALWAYS prints exactly one JSON line (its success metric, or an
@@ -547,7 +608,9 @@ def main_multichip(n_devices):
     MULTICHIP_r05.json had ok=true with an EMPTY tail because nothing
     on the success path printed). This entry point just maps the
     outcome to an exit code; if even the import fails, print the
-    skipped line here."""
+    skipped line here. The elastic_resume metric gets the same
+    guarantee: exactly one metric-or-skipped line."""
+    rc = 0
     try:
         import __graft_entry__ as _entry
     except BaseException as e:  # noqa: BLE001 — the line must print
@@ -555,12 +618,26 @@ def main_multichip(n_devices):
         print(json.dumps({"metric": "multichip_dryrun",
                           "skipped": True, "reason": msg[:300]}),
               flush=True)
-        return 1
+        rc = 1
+    else:
+        try:
+            _entry.dryrun_multichip(n_devices)
+        except BaseException:  # noqa: BLE001 — skipped line printed
+            rc = 1
     try:
-        _entry.dryrun_multichip(n_devices)
-        return 0
-    except BaseException:  # noqa: BLE001 — skipped line already printed
-        return 1
+        elapsed, hb_ms = bench_elastic_resume()
+        print(json.dumps({
+            "metric": "elastic_resume", "value": round(elapsed, 4),
+            "unit": "s", "heartbeat_timeout_ms": hb_ms,
+            "includes": "death detection + re-register at G+1 + "
+                        "digest-verified checkpoint restore"}),
+            flush=True)
+    except BaseException as e:  # noqa: BLE001 — the line must print
+        msg = "%s: %s" % (type(e).__name__, e)
+        print(json.dumps({"metric": "elastic_resume", "skipped": True,
+                          "reason": msg[:300]}), flush=True)
+        rc = 1
+    return rc
 
 
 def main():

@@ -11,18 +11,43 @@
 //
 // Wire protocol: newline-delimited text over TCP.
 //   ADD <id> <payload...>         -> OK
-//   GET <worker>                  -> TASK <id> <epoch> <payload> | NONE | ALLDONE
-//   FIN <id> <epoch>              -> OK | STALE
-//   FAIL <id> <epoch>             -> OK | STALE | DISCARDED
+//   GET <worker> [gen]            -> TASK <id> <epoch> <payload> | NONE
+//                                    | ALLDONE | GENMISMATCH <gen>
+//   FIN <id> <epoch> [gen]        -> OK | STALE | GENMISMATCH <gen>
+//   FAIL <id> <epoch> [gen]       -> OK | STALE | DISCARDED
+//                                    | GENMISMATCH <gen>
 //   RESET                         -> OK           (new pass: done -> todo)
 //   STATS                         -> STATS <todo> <pending> <done> <failed>
 //   PING                          -> PONG
 //   SHUTDOWN                      -> OK
 //
+// Cluster membership (the etcd-membership analog, elastic multi-host):
+//   REG <worker>                  -> GEN <generation> <n_live>
+//   HB <worker> <gen>             -> OK <generation> | GENMISMATCH <generation>
+//   CLUSTER                       -> CLUSTER <generation> <n_live> <deaths>
+//   MEMBERS                       -> MEMBERS <generation> <n> <id...> (sorted)
+//
+// The generation changes on EVERY membership change: a death bumps it,
+// and so does a genuinely new member joining a non-empty cluster (so
+// existing members' world-size/rank views are fenced stale and they
+// rebuild at the grown size). Re-registration of a current member does
+// not bump. A REGistered worker must heartbeat within hb_timeout_ms or
+// the master declares it dead: the worker is dropped from the member
+// table, the cluster GENERATION is bumped, and every task it held a
+// lease on is re-queued immediately (re-lease — no waiting out the
+// lease timeout).
+// Any command carrying a stale generation is fenced with GENMISMATCH so
+// a zombie from generation G-1 cannot corrupt the lease table after a
+// resize; survivors answer a GENMISMATCH heartbeat by re-registering.
+// Workers that never REG (legacy data-plane clients) are untouched by
+// all of this.
+//
 // Usage: task_master <port> <snapshot_path> [timeout_sec] [failure_max]
+//                    [hb_timeout_ms]
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -54,6 +79,10 @@ struct Task {
   std::string owner;
 };
 
+struct Worker {
+  Clock::time_point last_hb{};
+};
+
 struct Master {
   std::mutex mu;
   std::deque<std::string> todo;            // task ids
@@ -61,15 +90,26 @@ struct Master {
   std::vector<std::string> pending;        // leased ids
   std::vector<std::string> done;
   std::vector<std::string> failed;         // discarded (budget exhausted)
+  std::map<std::string, Worker> members;   // registered live workers
+  int generation = 1;                      // bumped on every member death
+  int deaths = 0;
   std::string snapshot_path;
   int timeout_sec = 30;
   int failure_max = 3;
+  int hb_timeout_ms = 10000;
   std::atomic<bool> stop{false};
 
   void snapshot_locked() {
     if (snapshot_path.empty()) return;
     std::string tmp = snapshot_path + ".tmp";
     std::ofstream f(tmp, std::ios::trunc);
+    // cluster meta first, then membership: a restarted master restores
+    // the member table with a FRESH heartbeat deadline, so survivors'
+    // beats simply resume at the same generation (no GENMISMATCH
+    // storm) and workers lost during the outage are reaped — with the
+    // usual generation bump — one deadline later
+    f << "META " << generation << " " << deaths << "\n";
+    for (auto& kv : members) f << "MEMBER " << kv.first << "\n";
     for (auto& kv : tasks) {
       const Task& t = kv.second;
       const char* state = "todo";
@@ -96,6 +136,17 @@ struct Master {
     while (std::getline(f, line)) {
       std::istringstream ss(line);
       std::string state, id;
+      if (line.rfind("META ", 0) == 0) {
+        ss >> state >> generation >> deaths;
+        continue;
+      }
+      if (line.rfind("MEMBER ", 0) == 0) {
+        // META precedes MEMBER lines in the snapshot, so generation
+        // is already the restored value here
+        ss >> state >> id;
+        members[id].last_hb = Clock::now();  // fresh deadline to re-appear
+        continue;
+      }
       Task t;
       ss >> state >> t.epoch >> t.failures >> id;
       std::getline(ss, t.payload);
@@ -125,6 +176,24 @@ struct Master {
     }
   }
 
+  // Re-lease everything a dead worker held. Unlike requeue_locked this
+  // does NOT charge the task's failure budget — the worker died, the
+  // task isn't bad — but DOES bump the epoch so a zombie's late
+  // FIN/FAIL lands STALE.
+  void release_worker_locked(const std::string& worker) {
+    std::vector<std::string> owned;
+    for (auto& id : pending)
+      if (tasks[id].owner == worker) owned.push_back(id);
+    for (auto& id : owned) {
+      Task& t = tasks[id];
+      t.epoch++;
+      t.owner.clear();
+      pending.erase(std::remove(pending.begin(), pending.end(), id),
+                    pending.end());
+      todo.push_back(id);
+    }
+  }
+
   void check_timeouts() {
     std::lock_guard<std::mutex> lk(mu);
     auto now = Clock::now();
@@ -132,7 +201,21 @@ struct Master {
     for (auto& id : pending)
       if (tasks[id].deadline < now) expired.push_back(id);
     for (auto& id : expired) requeue_locked(id);
-    if (!expired.empty()) snapshot_locked();
+    // membership reaper: a registered worker that missed its heartbeat
+    // deadline is dead — drop it, bump the generation, re-lease its
+    // chunks right now (the go/master + etcd-lease story in one place)
+    std::vector<std::string> dead;
+    for (auto& kv : members)
+      if (now - kv.second.last_hb >
+          std::chrono::milliseconds(hb_timeout_ms))
+        dead.push_back(kv.first);
+    for (auto& w : dead) {
+      members.erase(w);
+      deaths++;
+      generation++;
+      release_worker_locked(w);
+    }
+    if (!expired.empty() || !dead.empty()) snapshot_locked();
   }
 
   std::string handle(const std::string& line) {
@@ -141,6 +224,65 @@ struct Master {
     ss >> cmd;
     std::lock_guard<std::mutex> lk(mu);
     if (cmd == "PING") return "PONG";
+    if (cmd == "REG") {
+      std::string worker;
+      ss >> worker;
+      if (worker.empty()) return "ERR REG needs a worker id";
+      // A genuinely NEW member joining a non-empty cluster is a
+      // membership change: bump the generation so every existing
+      // member's view (world size, ranks) is fenced stale and they
+      // rebuild at the grown size. Re-registration of a current
+      // member (heartbeat rejoin, rendezvous refresh) is not a
+      // change and must not bump — otherwise post-death re-joins
+      // would cascade bumps forever.
+      bool is_new = members.find(worker) == members.end();
+      if (is_new && !members.empty()) generation++;
+      // fencing is against the master-global generation only — the
+      // worker record just tracks liveness
+      members[worker].last_hb = Clock::now();
+      // snapshot AFTER the insert (membership is persisted), and on
+      // every new member — the first joiner changes membership too
+      if (is_new) snapshot_locked();
+      std::ostringstream out;
+      out << "GEN " << generation << " " << members.size();
+      return out.str();
+    }
+    if (cmd == "MEMBERS") {
+      // consistent membership snapshot: the generation and the sorted
+      // live-member list in ONE response (std::map iterates in sorted
+      // order). Rank = index in this list; any membership change after
+      // the snapshot bumps the generation, so a stale view is always
+      // fenced rather than silently wrong.
+      std::ostringstream out;
+      out << "MEMBERS " << generation << " " << members.size();
+      for (auto& kv : members) out << " " << kv.first;
+      return out.str();
+    }
+    if (cmd == "HB") {
+      std::string worker;
+      int gen = -1;
+      ss >> worker >> gen;
+      auto it = members.find(worker);
+      if (it != members.end()) {
+        // a mismatched beat still proves liveness: don't let a slow
+        // re-registration cascade into a second (false) death
+        it->second.last_hb = Clock::now();
+      }
+      if (it == members.end() || gen != generation) {
+        std::ostringstream out;
+        out << "GENMISMATCH " << generation;
+        return out.str();
+      }
+      std::ostringstream out;
+      out << "OK " << generation;
+      return out.str();
+    }
+    if (cmd == "CLUSTER") {
+      std::ostringstream out;
+      out << "CLUSTER " << generation << " " << members.size() << " "
+          << deaths;
+      return out.str();
+    }
     if (cmd == "ADD") {
       Task t;
       ss >> t.id;
@@ -155,7 +297,15 @@ struct Master {
     }
     if (cmd == "GET") {
       std::string worker;
-      ss >> worker;
+      int gen = -1;
+      ss >> worker >> gen;
+      if (gen >= 0 && gen != generation) {
+        std::ostringstream out;
+        out << "GENMISMATCH " << generation;
+        return out.str();
+      }
+      auto mit = members.find(worker);
+      if (mit != members.end()) mit->second.last_hb = Clock::now();
       if (todo.empty()) {
         if (pending.empty()) return "ALLDONE";
         return "NONE";  // stragglers in flight; caller retries
@@ -174,7 +324,16 @@ struct Master {
     if (cmd == "FIN" || cmd == "FAIL") {
       std::string id;
       int epoch;
-      ss >> id >> epoch;
+      int gen = -1;
+      ss >> id >> epoch >> gen;
+      if (gen >= 0 && gen != generation) {
+        // generation fence: a zombie from before the resize cannot
+        // mutate the lease table, even if its (id, epoch) pair still
+        // happened to match
+        std::ostringstream out;
+        out << "GENMISMATCH " << generation;
+        return out.str();
+      }
       auto it = tasks.find(id);
       if (it == tasks.end() || it->second.epoch != epoch)
         return "STALE";  // lease superseded (go/master Epoch check)
@@ -218,9 +377,23 @@ struct Master {
 };
 
 void serve_conn(Master* m, int fd) {
+  // Drains on shutdown: every line the client already sent gets its
+  // response before the socket closes — including lines buffered
+  // BEHIND a SHUTDOWN in the same write. The old loop checked m->stop
+  // before recv, so in-flight requests died unanswered.
   std::string buf;
   char tmp[4096];
-  while (!m->stop) {
+  for (;;) {
+    // poll, not select: accepted fds are unbounded (each elastic
+    // worker holds 2+ persistent connections) and FD_SET on an
+    // fd >= FD_SETSIZE is a stack overwrite
+    pollfd pfd{fd, POLLIN, 0};
+    int r = poll(&pfd, 1, 100);
+    if (r < 0) break;
+    if (r == 0) {
+      if (m->stop) break;  // shutting down and the pipe is drained
+      continue;
+    }
     ssize_t n = recv(fd, tmp, sizeof(tmp), 0);
     if (n <= 0) break;
     buf.append(tmp, n);
@@ -245,7 +418,7 @@ int main(int argc, char** argv) {
   if (argc < 3) {
     fprintf(stderr,
             "usage: task_master <port> <snapshot_path> [timeout_sec] "
-            "[failure_max]\n");
+            "[failure_max] [hb_timeout_ms]\n");
     return 2;
   }
   Master m;
@@ -253,6 +426,7 @@ int main(int argc, char** argv) {
   m.snapshot_path = argv[2];
   if (argc > 3) m.timeout_sec = atoi(argv[3]);
   if (argc > 4) m.failure_max = atoi(argv[4]);
+  if (argc > 5) m.hb_timeout_ms = atoi(argv[5]);
   m.recover();
 
   int srv = socket(AF_INET, SOCK_STREAM, 0);

@@ -95,6 +95,16 @@ step_deadline_sec: default hung-step watchdog deadline for
 fault_injection: master switch for resilience.faults — with it False
   (default) every armed fault is inert and each hook site costs one
   flag check. Chaos tests/probes arm it explicitly.
+
+elastic_heartbeat_interval_sec: default cadence of the membership
+  heartbeat thread (distributed/elastic.py MembershipHeartbeat). Pair
+  with the master's ``heartbeat_timeout_ms`` (MasterServer): the
+  deadline should cover several beats so one delayed beat isn't a
+  declared death.
+
+elastic_max_restarts: how many teardown/rebuild cycles an
+  ElasticTrainerLoop tolerates before raising ElasticRestartLimit —
+  bounds a flapping cluster, like nonfinite_budget bounds divergence.
 """
 
 import jax
@@ -120,6 +130,11 @@ _flags = {
     "reader_retries": 3,
     "step_deadline_sec": 0,
     "fault_injection": False,
+    # elastic multi-host (distributed/elastic.py; only read by the
+    # elastic runtime — with no ElasticTrainerLoop constructed, nothing
+    # on the single-process train path looks at these)
+    "elastic_heartbeat_interval_sec": 2.0,
+    "elastic_max_restarts": 3,
 }
 
 # Observers called with the flag dict after every set_flags (the

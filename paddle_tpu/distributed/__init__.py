@@ -8,7 +8,17 @@ Replaces the reference's distribution stack per SURVEY §5.8:
   budget, snapshot recovery).
 * multi-host bring-up: launch.py wraps jax.distributed.initialize (the
   jax.distributed runtime replaces pserver endpoints/etcd discovery).
+* elastic membership: elastic.py — heartbeat-tracked cluster
+  generations over the master's REG/HB protocol, hang-free collective
+  abort, and the ElasticTrainerLoop that resumes training on a resized
+  mesh after a peer death (go/master re-lease + etcd membership,
+  joined).
 """
 
-from .master import MasterServer, MasterClient, ElasticDataDispatcher  # noqa
-from .launch import init_multihost  # noqa: F401
+from .master import (MasterServer, MasterClient,  # noqa: F401
+                     ElasticDataDispatcher, GenerationMismatch)
+from .launch import (init_multihost, shutdown_multihost,  # noqa: F401
+                     multihost_active)
+from .elastic import (ElasticTrainerLoop, ElasticWorld,  # noqa: F401
+                      MembershipHeartbeat, ElasticRestartLimit,
+                      collective_abort)

@@ -7,24 +7,44 @@ to MasterClient; cloud_reader maps to ElasticDataDispatcher.reader().
 """
 
 import os
+import random
 import socket
 import subprocess
 import time
 
 from .. import native
 
-__all__ = ["MasterServer", "MasterClient", "ElasticDataDispatcher"]
+__all__ = ["MasterServer", "MasterClient", "ElasticDataDispatcher",
+           "GenerationMismatch"]
+
+
+class GenerationMismatch(RuntimeError):
+    """A call carried a stale cluster generation (the caller belongs to
+    a membership epoch that a worker death has since superseded). The
+    current generation rides along so the caller can re-register."""
+
+    def __init__(self, current_generation, message=None):
+        super().__init__(message or
+                         "stale cluster generation (current is %d)"
+                         % current_generation)
+        self.current_generation = current_generation
 
 
 class MasterServer:
-    """Spawns the C++ task_master daemon on localhost."""
+    """Spawns the C++ task_master daemon on localhost.
+
+    ``heartbeat_timeout_ms`` is the membership deadline: a worker that
+    REGistered and then misses heartbeats for this long is declared
+    dead (generation bump + immediate re-lease of its chunks). Workers
+    that never register — every pre-elastic client — are unaffected.
+    """
 
     def __init__(self, snapshot_path, port=0, timeout_sec=30,
-                 failure_max=3):
+                 failure_max=3, heartbeat_timeout_ms=10000):
         binary = native.task_master_binary()
         self.proc = subprocess.Popen(
             [binary, str(port), snapshot_path, str(timeout_sec),
-             str(failure_max)],
+             str(failure_max), str(int(heartbeat_timeout_ms))],
             stdout=subprocess.PIPE, text=True)
         line = self.proc.stdout.readline().strip()
         if not line.startswith("LISTENING"):
@@ -33,6 +53,11 @@ class MasterServer:
         self.snapshot_path = snapshot_path
 
     def stop(self, graceful=True):
+        """Stop the daemon. ``graceful`` sends SHUTDOWN and waits: the
+        master answers every client line already on the wire (including
+        lines queued behind the SHUTDOWN itself) before its connection
+        threads close — in-flight work drains instead of dying with a
+        reset socket."""
         if self.proc.poll() is not None:
             return
         if graceful:
@@ -52,9 +77,15 @@ class MasterServer:
 
 
 class MasterClient:
-    def __init__(self, port, host="127.0.0.1", retries=3):
+    """One line-protocol connection. NOT thread-safe — give each thread
+    (e.g. a heartbeat thread) its own client."""
+
+    def __init__(self, port, host="127.0.0.1", retries=3,
+                 backoff=0.1, backoff_cap=2.0):
         self.addr = (host, port)
         self.retries = retries
+        self.backoff = backoff
+        self.backoff_cap = backoff_cap
         self._sock = None
         self._file = None
 
@@ -76,6 +107,14 @@ class MasterClient:
         self._file = None
         self._sock = None
 
+    def _retry_delay(self, attempt):
+        """Jittered exponential backoff: the old fixed-ramp retry made
+        every disconnected worker hammer a restarting master in
+        lockstep; the jitter (uniform over [d/2, d]) decorrelates the
+        reconnect herd."""
+        d = min(self.backoff_cap, self.backoff * (2 ** attempt))
+        return d * (0.5 + 0.5 * random.random())
+
     def _call(self, line):
         for attempt in range(self.retries):
             try:
@@ -88,8 +127,18 @@ class MasterClient:
             except OSError:
                 pass
             self._close()
-            time.sleep(0.2 * (attempt + 1))
+            time.sleep(self._retry_delay(attempt))
         raise ConnectionError("master unreachable at %s:%d" % self.addr)
+
+    @staticmethod
+    def _fence_check(resp):
+        if resp.startswith("GENMISMATCH"):
+            raise GenerationMismatch(int(resp.split()[1]))
+        return resp
+
+    @staticmethod
+    def _gen_suffix(generation):
+        return "" if generation is None else " %d" % generation
 
     def ping(self):
         return self._call("PING") == "PONG"
@@ -97,10 +146,12 @@ class MasterClient:
     def add_task(self, task_id, payload=""):
         return self._call("ADD %s %s" % (task_id, payload))
 
-    def get_task(self, worker_id="w0"):
+    def get_task(self, worker_id="w0", generation=None):
         """Returns (task_id, epoch, payload) or None (retry later) or
-        'ALLDONE'."""
-        resp = self._call("GET %s" % worker_id)
+        'ALLDONE'. With ``generation``, the call is fenced: a stale
+        generation raises GenerationMismatch instead of leasing."""
+        resp = self._fence_check(self._call(
+            "GET %s%s" % (worker_id, self._gen_suffix(generation))))
         if resp == "NONE":
             return None
         if resp == "ALLDONE":
@@ -109,11 +160,48 @@ class MasterClient:
         return (parts[1], int(parts[2]),
                 parts[3] if len(parts) > 3 else "")
 
-    def task_finished(self, task_id, epoch):
-        return self._call("FIN %s %d" % (task_id, epoch))
+    def task_finished(self, task_id, epoch, generation=None):
+        return self._fence_check(self._call(
+            "FIN %s %d%s" % (task_id, epoch,
+                             self._gen_suffix(generation))))
 
-    def task_failed(self, task_id, epoch):
-        return self._call("FAIL %s %d" % (task_id, epoch))
+    def task_failed(self, task_id, epoch, generation=None):
+        return self._fence_check(self._call(
+            "FAIL %s %d%s" % (task_id, epoch,
+                              self._gen_suffix(generation))))
+
+    # -- cluster membership (elastic multi-host) --------------------------
+    def register(self, worker_id):
+        """(Re-)register as a live member at the current generation.
+        Returns (generation, n_live)."""
+        resp = self._call("REG %s" % worker_id)
+        if not resp.startswith("GEN "):
+            raise ConnectionError("bad REG response %r" % resp)
+        _, gen, n_live = resp.split()
+        return int(gen), int(n_live)
+
+    def heartbeat(self, worker_id, generation):
+        """One liveness beat. Returns the current generation on match;
+        raises GenerationMismatch when the cluster resized (or a
+        restarted master forgot us) — re-register and rebuild."""
+        resp = self._call("HB %s %d" % (worker_id, generation))
+        self._fence_check(resp)
+        return int(resp.split()[1])
+
+    def cluster(self):
+        """{'generation', 'live', 'deaths'} — the membership view."""
+        parts = self._call("CLUSTER").split()
+        return {"generation": int(parts[1]), "live": int(parts[2]),
+                "deaths": int(parts[3])}
+
+    def members(self):
+        """(generation, sorted live worker ids) in ONE consistent
+        snapshot: any membership change after it bumps the generation,
+        so a stale view is always fenced rather than silently wrong.
+        Rank = index in the sorted list."""
+        parts = self._call("MEMBERS").split()
+        n = int(parts[2])
+        return int(parts[1]), parts[3:3 + n]
 
     def reset_pass(self):
         return self._call("RESET")
@@ -132,11 +220,18 @@ class ElasticDataDispatcher:
     master; a worker's reader pulls chunk leases and yields samples
     (reference cloud_reader + master GetTask loop)."""
 
-    def __init__(self, client, recordio_path, worker_id="w0"):
+    def __init__(self, client, recordio_path, worker_id="w0",
+                 generation=None):
         """``recordio_path``: one path, a glob pattern, or a list of
         paths (the output of ``dataset.common.convert`` — reference
-        cloud_reader's etcd glob, go/master/service.go partition)."""
+        cloud_reader's etcd glob, go/master/service.go partition).
+
+        ``generation``: fence every lease call with this cluster
+        generation (elastic runtime): after a resize, a dispatcher
+        built for the old generation raises GenerationMismatch instead
+        of silently corrupting the lease table."""
         self.client = client
+        self.generation = generation
         if isinstance(recordio_path, (list, tuple)):
             self.paths = list(recordio_path)
         elif any(ch in recordio_path for ch in "*?["):
@@ -179,7 +274,8 @@ class ElasticDataDispatcher:
                 # carry the pass across the outage
                 _faults.fire_point("master_kill", leases)
                 leases += 1
-                task = self.client.get_task(self.worker_id)
+                task = self.client.get_task(self.worker_id,
+                                            generation=self.generation)
                 if task == "ALLDONE":
                     return
                 if task is None:
@@ -195,7 +291,9 @@ class ElasticDataDispatcher:
                             self.paths[pi], [chunk], deserialize=de)():
                         yield sample
                 except Exception:
-                    self.client.task_failed(task_id, epoch)
+                    self.client.task_failed(task_id, epoch,
+                                            generation=self.generation)
                     continue
-                self.client.task_finished(task_id, epoch)
+                self.client.task_finished(task_id, epoch,
+                                          generation=self.generation)
         return gen

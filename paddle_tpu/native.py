@@ -24,9 +24,23 @@ def build_native():
     return _BUILD_DIR
 
 
+def _source_for(name):
+    """The .cc a build artifact comes from (lib<stem>.so / bare binary)."""
+    stem = name
+    if stem.startswith("lib") and stem.endswith(".so"):
+        stem = stem[3:-3]
+    return os.path.join(_NATIVE_DIR, stem + ".cc")
+
+
 def _ensure(name):
     path = os.path.join(_BUILD_DIR, name)
-    if not os.path.exists(path):
+    src = _source_for(name)
+    stale = os.path.exists(path) and os.path.exists(src) and \
+        os.path.getmtime(src) > os.path.getmtime(path)
+    if not os.path.exists(path) or stale:
+        # stale: the artifact predates its source (e.g. a task_master
+        # binary from before a protocol change) — make rebuilds only
+        # what changed
         build_native()
     return path
 

@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["Mesh", "P", "make_mesh", "DistStrategy", "DataParallel",
            "ring_attention", "dense_attention", "current_strategy",
-           "set_current_strategy"]
+           "set_current_strategy", "resize_strategy"]
 
 _current_strategy = None
 
@@ -149,16 +149,33 @@ class DistStrategy:
                         "(logged once)", name, e)
         return jax.device_put(array, sharding)
 
+    _packed_fallback_logged = False
+
     def scatter_packed(self, buf):
         """Scatter a packed ingest block (shards, shard_nbytes) row-wise
         over the data axis — row s rides one H2D to mesh device s (and
         to each replica of it on any orthogonal axis). Returns
-        (global_array, n_transfers). Replicates when there is no data
-        axis or the shard count doesn't match it."""
+        (global_array, n_transfers).
+
+        Shard-count-change-safe: after an elastic resize, batches may
+        arrive packed for the OLD shard count. Any row count divisible
+        by the new data axis still scatters (k rows per device); an
+        indivisible count — e.g. 3 packed rows landing on a 2-way mesh —
+        replicates instead of crashing mid-resume, and says so once
+        (the replicated transfer re-pays the bytes the scatter avoids,
+        so silence would hide a real regression)."""
         if self.data_axis is not None and buf.shape[0] > 1 and \
                 buf.shape[0] % self.data_shards() == 0:
             return self._scatter_host(
                 buf, self._named(P(self.data_axis, None)))
+        if self.data_axis is not None and buf.shape[0] > 1 and \
+                not DistStrategy._packed_fallback_logged:
+            DistStrategy._packed_fallback_logged = True
+            import logging
+            logging.getLogger("paddle_tpu").warning(
+                "packed batch has %d shard rows but the mesh data axis "
+                "is %d-way (resized mesh?); replicating the block "
+                "(logged once)", buf.shape[0], self.data_shards())
         return self._scatter_host(buf, self.replicated())
 
     def shard_state(self, name, array):
@@ -168,6 +185,48 @@ class DistStrategy:
 
 
 from .ring_attention import ring_attention, dense_attention  # noqa: E402
+
+
+def resize_strategy(strategy, devices=None):
+    """Rebuild a strategy's mesh over the CURRENT (possibly resized)
+    device set — the elastic-resume primitive: after a lost host and a
+    re-init at the surviving world size, the old mesh names devices
+    that no longer exist. Non-data axes (e.g. a 2-way model axis) keep
+    their extent; the data axis absorbs the change. Returns a NEW
+    DistStrategy (fresh uid, so executor cache entries re-key) sharing
+    the original's param rules."""
+    devices = devices if devices is not None else jax.devices()
+    old_sizes = dict(zip(strategy.mesh.axis_names,
+                         strategy.mesh.devices.shape))
+    fixed = {a: s for a, s in old_sizes.items()
+             if a != strategy.data_axis}
+    fixed_total = int(np.prod(list(fixed.values()))) if fixed else 1
+    if len(devices) < fixed_total:
+        raise ValueError(
+            "resize needs at least %d devices for the non-data axes "
+            "%r, have %d" % (fixed_total, fixed, len(devices)))
+    axes = {}
+    for a in strategy.mesh.axis_names:  # preserve axis order
+        if a == strategy.data_axis:
+            axes[a] = len(devices) // fixed_total
+        else:
+            axes[a] = old_sizes[a]
+    used = int(np.prod(list(axes.values())))
+    if used < len(devices):
+        # e.g. 6 survivors with a fixed 4-way model axis -> a 4-device
+        # mesh; the 2 stranded devices are a real capacity loss the
+        # operator should see, not silently eat every generation
+        import logging
+        logging.getLogger("paddle_tpu").warning(
+            "resize_strategy: mesh %r uses %d of %d surviving devices "
+            "(%d stranded by the non-data axes %r)",
+            axes, used, len(devices), len(devices) - used, fixed)
+    mesh = make_mesh(axes, devices)
+    return DistStrategy(
+        mesh, data_axis=strategy.data_axis or "data",
+        model_axis=strategy.model_axis or "model",
+        param_rules=[(pat.pattern, spec)
+                     for pat, spec in strategy.param_rules])
 
 
 def DataParallel(mesh=None, n_devices=None, param_rules=None):

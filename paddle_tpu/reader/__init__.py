@@ -47,6 +47,8 @@ def buffered(reader, size):
             try:
                 for sample in reader():
                     q.put(sample)
+            except Exception as e:  # surface in the consumer
+                q.put(e)
             finally:
                 q.put(end)
 
@@ -56,6 +58,11 @@ def buffered(reader, size):
             sample = q.get()
             if sample is end:
                 break
+            if isinstance(sample, Exception):
+                # a reader failure (e.g. a generation-fenced dispatcher
+                # raising GenerationMismatch) must not read as a clean
+                # end-of-pass — re-raise where the train loop can see it
+                raise sample
             yield sample
     return reader_creator
 

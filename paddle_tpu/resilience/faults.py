@@ -39,6 +39,22 @@ site                          where / what
                               is the submit sequence number; default
                               exception ServingOverloadError (counted as
                               a shed)
+``worker_kill``               ResilientTrainer, before the step — arm with
+                              ``action="kill"`` at a step to SIGKILL one
+                              worker of an elastic multi-host run mid-pass
+                              (the peer-death chaos shape)
+``heartbeat_drop``            distributed.elastic MembershipHeartbeat —
+                              ``index`` is the beat number; a firing spec
+                              SWALLOWS the beat (no exception), so
+                              ``times=K`` simulates K beats of network
+                              partition and forces a master-declared death
+                              of a live process
+``collective_hang``           ResilientTrainer, before the step — the step
+                              blocks like an all-reduce whose peer died
+                              (interruptible sleep loop; a ``callback``
+                              spec runs instead if armed that way). Only
+                              the watchdog's abort escalation gets out —
+                              the bounded-hang proof for step_deadline_sec
 ============================  =============================================
 
 Actions: ``"raise"`` (raise ``exc``, default :class:`InjectedFault`),
@@ -50,12 +66,13 @@ process-death simulation for subprocess chaos tests), or
 import os
 import signal
 import threading
+import time
 
 from .. import config as _config
 from ..utils import log as _log
 
 __all__ = ["InjectedFault", "arm", "disarm", "armed", "should_fire",
-           "fire_point", "poison_feed"]
+           "fire_point", "poison_feed", "simulate_collective_hang"]
 
 
 class InjectedFault(Exception):
@@ -156,6 +173,32 @@ def fire_point(site, index=None, default_exc=None):
         raise spec.exc
     raise (default_exc or InjectedFault)(
         "injected fault at %s[%s]" % (site, index))
+
+
+def simulate_collective_hang(step, max_sec=600.0):
+    """``collective_hang`` hook: when armed for ``step``, block like a
+    collective whose peer was SIGKILLed — an interruptible sleep loop
+    that only an asynchronous unwind (the step watchdog's
+    ``interrupt_main`` abort, delivered as KeyboardInterrupt) escapes.
+    A ``callback`` spec runs the callback instead. ``max_sec`` is a
+    backstop so an unwatched test can't wedge CI forever; a REAL hung
+    XLA call has no such mercy, which is the point of the escalation
+    path this site exists to prove."""
+    spec = should_fire("collective_hang", step)
+    if spec is None:
+        return
+    _log.structured("fault_injected", site="collective_hang",
+                    index=step, action=spec.action)
+    if spec.action == "callback":
+        spec.callback()
+        return
+    deadline = None if max_sec is None else \
+        (time.monotonic() + max_sec)
+    while deadline is None or time.monotonic() < deadline:
+        time.sleep(0.05)
+    raise InjectedFault(
+        "collective_hang at step %s outlived its %.0fs backstop — "
+        "no watchdog abort arrived" % (step, max_sec))
 
 
 def poison_feed(feed, step):

@@ -73,6 +73,7 @@ class RecoveryPolicy:
                  lr_backoff=None, reader_retries=None,
                  reader_backoff=0.05, transient_exceptions=(OSError,),
                  step_deadline_sec=None, watchdog_abort=False,
+                 on_hang=None,
                  preempt_signals=(signal.SIGTERM, signal.SIGINT)):
         self.nonfinite_policy = (nonfinite_policy or
                                  _config.get_flag("nonfinite_policy"))
@@ -96,6 +97,12 @@ class RecoveryPolicy:
             _config.get_flag("step_deadline_sec")
             if step_deadline_sec is None else step_deadline_sec)
         self.watchdog_abort = watchdog_abort
+        # hang escalation: called (step, elapsed_sec) from the watchdog
+        # thread BEFORE the abort — the place to tear down a wedged
+        # distributed runtime (e.g. distributed.elastic.collective_abort
+        # severs jax.distributed so the abort can actually unwind the
+        # loop instead of re-entering the dead collective)
+        self.on_hang = on_hang
         self.preempt_signals = tuple(preempt_signals)
 
 
@@ -173,9 +180,15 @@ class StepWatchdog:
     keeps SIGINT on its default handler while abort is armed, since
     ``interrupt_main`` is delivered as SIGINT."""
 
-    def __init__(self, deadline_sec, abort=False, poll_interval=None):
+    def __init__(self, deadline_sec, abort=False, poll_interval=None,
+                 on_hang=None):
         self.deadline = float(deadline_sec)
         self.abort = abort
+        # escalation hook, called (step, elapsed) once per overrunning
+        # step from the watchdog thread, before the abort fires; errors
+        # are logged, never raised — a broken escalation must not kill
+        # the watchdog
+        self.on_hang = on_hang
         self._poll = poll_interval if poll_interval is not None else \
             min(max(self.deadline / 4.0, 0.005), 1.0)
         self._lock = threading.Lock()
@@ -225,7 +238,15 @@ class StepWatchdog:
             _log.structured("watchdog_stall", step=step,
                             elapsed_sec=round(elapsed, 3),
                             deadline_sec=self.deadline,
-                            abort=self.abort)
+                            abort=self.abort,
+                            escalated=self.on_hang is not None)
+            if self.on_hang is not None:
+                try:
+                    self.on_hang(step, elapsed)
+                except Exception:  # noqa: BLE001 — watchdog must live
+                    _log.logger().warning(
+                        "watchdog on_hang escalation failed",
+                        exc_info=True)
             if self.abort:
                 import _thread
                 _thread.interrupt_main()
@@ -294,11 +315,20 @@ class ResilientTrainer(Trainer):
 
     # -- per-step ------------------------------------------------------------
     def _train_feed(self, feed):
-        if _config.get_flag("fault_injection"):
+        fault_injection = _config.get_flag("fault_injection")
+        if fault_injection:
             feed = _faults.poison_feed(feed, self.step_id)
+            # elastic chaos: hard-kill this worker mid-pass (the
+            # SIGKILLed-peer shape for subprocess tests)
+            _faults.fire_point("worker_kill", self.step_id)
         if self._watchdog is not None:
             self._watchdog.step_started(self.step_id)
         try:
+            if fault_injection:
+                # wedge INSIDE the watchdog window, like a collective
+                # whose peer died — only the on_hang/abort escalation
+                # path gets out
+                _faults.simulate_collective_hang(self.step_id)
             return super()._train_feed(feed)
         finally:
             if self._watchdog is not None:
@@ -406,7 +436,8 @@ class ResilientTrainer(Trainer):
         if self.policy.step_deadline_sec:
             self._watchdog = StepWatchdog(
                 self.policy.step_deadline_sec,
-                abort=self.policy.watchdog_abort).start()
+                abort=self.policy.watchdog_abort,
+                on_hang=self.policy.on_hang).start()
         sigs = self.policy.preempt_signals
         if self.policy.watchdog_abort:
             # the abort path delivers interrupt_main() as SIGINT; if the

@@ -128,6 +128,11 @@ class Trainer:
         # resilience/supervisor.py); checked at step boundaries so the
         # in-flight step always completes before the loop exits
         self._stop_reason = None
+        # elastic restart: set by request_restart() (e.g. the
+        # membership-heartbeat thread on a cluster generation bump —
+        # distributed/elastic.py); same step-boundary discipline, but
+        # the caller rebuilds the runtime and resumes instead of exiting
+        self._restart_reason = None
         # telemetry window: (first-step start time, examples since);
         # the throughput gauge is per-instance ("trainer" label) — two
         # Trainers must not clobber one label-less value
@@ -226,6 +231,16 @@ class Trainer:
         record. Signal-handler safe (only sets a flag)."""
         self._stop_reason = reason
 
+    def request_restart(self, reason="elastic"):
+        """Ask the train loop to stop at the next step boundary for a
+        runtime rebuild (elastic resize): the in-flight step finishes, a
+        checkpoint is written at the clean boundary, and ``train``
+        returns a record with ``restart: True`` so the supervising loop
+        (distributed.elastic.ElasticTrainerLoop) can tear down and
+        re-initialize at the new world size. Thread/signal-safe (only
+        sets a flag)."""
+        self._restart_reason = reason
+
     def restore_checkpoint(self):
         """Reload the newest intact checkpoint into the scope and rewind
         ``step_id`` to it (the rollback primitive). Returns the restored
@@ -285,10 +300,14 @@ class Trainer:
         writes a final checkpoint whose ``latest.json`` carries the
         resume metadata, and returns that metadata dict.
         """
-        # clear any stale stop BEFORE startup: a preemption signal
-        # landing during startup's (possibly long) checkpoint load must
-        # survive into the loop, not be wiped after it
-        self._stop_reason = None
+        # do NOT clear _stop_reason/_restart_reason here: a preemption
+        # signal or a heartbeat restart request landing before train()
+        # is entered — e.g. during an EXTERNAL startup()/restore (the
+        # elastic loop runs startup first to time the resume) — must
+        # survive into the loop, not be wiped. Leftovers from a
+        # previous train() on this object can't leak: both exit
+        # epilogues clear both flags, and the exception path below
+        # clears them.
         self.startup()
         event_handler = event_handler or (lambda e: None)
         staged = None
@@ -323,17 +342,30 @@ class Trainer:
                     last_batch_id = batch_id
                     event_handler(EndIteration(pass_id, batch_id,
                                                self.step_id, metrics))
-                    if self._stop_reason:
+                    if self._stop_reason or self._restart_reason:
                         break
                 if self._stop_reason:
                     return self._preempt_exit(pass_id, last_batch_id)
+                if self._restart_reason:
+                    return self._restart_exit(pass_id, last_batch_id)
                 if self.checkpoint_dir:
                     self._save_checkpoint(_config.get_flag("telemetry"))
                 event_handler(EndPass(pass_id, last_metrics))
+            # normal completion: a stop/restart landing after the final
+            # per-pass check (during the last checkpoint save, EndPass,
+            # or between passes' checks) arrives with training already
+            # done — clear it here so it can't replay as a phantom
+            # preempt/restart exit in a later train() on this object
+            self._stop_reason = None
+            self._restart_reason = None
         except BaseException:
             # flag for teardown: sys.exc_info() in the finally would
             # also see an outer HANDLED exception and misreport
             exc_live = True
+            # an unconsumed stop/restart must not leak into a later
+            # train() on a reused trainer
+            self._stop_reason = None
+            self._restart_reason = None
             raise
         finally:
             if staged is not None:
@@ -351,8 +383,31 @@ class Trainer:
             self._save_checkpoint(_config.get_flag("telemetry"),
                                   extra_meta=resume)
         _log.structured("train_preempted", **resume)
+        # clear BOTH flags: a restart request that lost the race to a
+        # preemption in the same window must not leak into the next
+        # train() on this object and fake an instant restart
         self._stop_reason = None
+        self._restart_reason = None
         return resume
+
+    def _restart_exit(self, pass_id, batch_id):
+        """Elastic-restart epilogue: the loop stopped at a clean step
+        boundary (state is consistent — unlike the hang-abort path,
+        which restores from the last checkpoint instead), so persist a
+        checkpoint for the post-rebuild trainer to resume from and hand
+        the restart record back to the supervising loop."""
+        record = {"restart": True, "reason": self._restart_reason,
+                  "pass_id": pass_id, "batch_id": batch_id,
+                  "step": self.step_id}
+        if self.checkpoint_dir:
+            self._save_checkpoint(_config.get_flag("telemetry"),
+                                  extra_meta=record)
+        _log.structured("train_restart_requested", **record)
+        # clear BOTH flags (see _preempt_exit): a stop landing between
+        # the loop's two checks must not leak into the next train()
+        self._restart_reason = None
+        self._stop_reason = None
+        return record
 
     @staticmethod
     def _teardown_staged(staged, batches, exc_live):

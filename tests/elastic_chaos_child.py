@@ -1,0 +1,168 @@
+"""Worker for the elastic multi-host chaos tests
+(test_elastic.py::test_elastic_chaos_sigkill_one_of_three and
+tools/multihost_chaos_probe.py).
+
+Each worker joins the task master's membership (REG + background
+heartbeats), trains a small regressor over a generation-fenced
+ElasticDataDispatcher reader through an ElasticTrainerLoop, and
+checkpoints every step. A worker launched with ``kill_at_step > 0``
+arms the ``worker_kill`` fault and SIGKILLs ITSELF mid-pass — the
+survivors must detect the death via heartbeat timeout, restart at
+generation G+1, restore their newest intact checkpoint, and finish the
+pass (the master re-leases the dead worker's chunks to them).
+
+argv: repo master_port ds_glob ckpt_dir out_json worker_idx
+      kill_at_step [n_workers]
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+repo = sys.argv[1]
+master_port = int(sys.argv[2])
+ds_glob = sys.argv[3]
+ckpt_dir = sys.argv[4]
+out_json = sys.argv[5]
+worker_idx = int(sys.argv[6])
+kill_at_step = int(sys.argv[7])
+n_workers = int(sys.argv[8]) if len(sys.argv) > 8 else 1
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, repo)
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as ptpu  # noqa: E402
+from paddle_tpu import layers  # noqa: E402
+from paddle_tpu.data_feeder import DataFeeder  # noqa: E402
+from paddle_tpu.distributed import (ElasticDataDispatcher,  # noqa: E402
+                                    ElasticTrainerLoop)
+from paddle_tpu.observability import metrics  # noqa: E402
+from paddle_tpu.resilience import (RecoveryPolicy,  # noqa: E402
+                                   ResilientTrainer, faults)
+from paddle_tpu.trainer import EndIteration  # noqa: E402
+
+B = 8
+WID = "w%d" % worker_idx
+
+losses = []
+seen = []
+resumed_at = []  # wall-clock stamps of post-restart resumes
+
+
+def _flush_and_die():
+    """worker_kill callback: flush consumed-sample progress for the
+    harness (at-least-once coverage accounting), then die hard — the
+    SIGKILL is real, the flush just makes the assertion checkable
+    (same shape as elastic_worker.py's crash flush)."""
+    with open(out_json + ".crash", "w") as f:
+        json.dump({"seen": seen, "losses": losses,
+                   "killed_at": time.time()}, f)
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+if kill_at_step:
+    faults.arm("worker_kill", at=kill_at_step, action="callback",
+               callback=_flush_and_die)
+
+
+def build(world):
+    print("BRINGUP gen=%d live=%d t=%.3f" % (world.generation,
+                                             world.n_live, time.time()),
+          flush=True)
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        xv = layers.data("x", shape=[4])
+        yv = layers.data("y", shape=[1])
+        h = layers.fc(xv, 8, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(pred, yv))
+        ptpu.optimizer.SGD(learning_rate=0.05).minimize(
+            loss, startup_program=startup)
+    trainer = ResilientTrainer(
+        loss, feeder=DataFeeder([xv, yv]), main_program=main,
+        startup_program=startup, checkpoint_dir=ckpt_dir,
+        checkpoint_every_n_steps=1,
+        # the watchdog bounds any wedged step (collective-hang class);
+        # generous vs the CPU step time, small vs the test timeout
+        policy=RecoveryPolicy(step_deadline_sec=30))
+    disp = ElasticDataDispatcher(world.client, ds_glob, worker_id=WID,
+                                 generation=world.generation)
+
+    def reader():
+        batch = []
+        for s in disp.reader(poll_interval=0.1)():
+            seen.append(int(s[0]))
+            batch.append((np.asarray(s[1], "float32"),
+                          np.asarray(s[2], "float32")))
+            time.sleep(0.03)  # keep the pass longer than detection
+            if len(batch) == B:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+    return trainer, reader
+
+
+resumed_len = [0]
+
+
+def handler(e):
+    """First completed step after each restart = the resumed step."""
+    if isinstance(e, EndIteration):
+        losses.append(float(np.asarray(e.cost)))
+        if loop.restarts > resumed_len[0]:
+            resumed_len[0] = loop.restarts
+            resumed_at.append(time.time())
+            print("RESUMED step=%d gen=%d t=%.3f"
+                  % (e.step_id, loop.generations[-1], time.time()),
+                  flush=True)
+loop = ElasticTrainerLoop(build, master_port, worker_id=WID,
+                          heartbeat_interval_sec=0.2,
+                          min_workers=n_workers)
+print("READY %s pid=%d t=%.3f" % (WID, os.getpid(), time.time()),
+      flush=True)
+result = loop.run(num_passes=1, event_handler=handler,
+                  prefetch=0, staging=False)
+
+
+def _metric(name):
+    fam = metrics.REGISTRY.families().get(name)
+    if fam is None:
+        return 0.0
+    return sum(c.value for c in fam.children().values())
+
+
+def _hist(name):
+    fam = metrics.REGISTRY.families().get(name)
+    vals = {"count": 0, "sum": 0.0}
+    if fam:
+        for c in fam.children().values():
+            vals["count"] += c.count
+            vals["sum"] += c.sum
+    return vals
+
+
+with open(out_json, "w") as f:
+    json.dump({
+        "worker": WID,
+        "generations": loop.generations,
+        "restarts": loop.restarts,
+        "losses": losses,
+        "seen": seen,
+        "resumed_at": resumed_at,
+        "deaths_observed": _metric("paddle_elastic_worker_deaths_total"),
+        "resume_seconds": _hist("paddle_elastic_resume_seconds"),
+        "result": result,
+    }, f)
+print("DONE %s gens=%s restarts=%d final_loss=%.5f t=%.3f"
+      % (WID, loop.generations, loop.restarts,
+         losses[-1] if losses else float("nan"), time.time()),
+      flush=True)

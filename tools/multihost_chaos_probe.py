@@ -1,0 +1,160 @@
+"""Multi-host elasticity chaos probe: SIGKILL a worker, watch the
+cluster heal, headless.
+
+The multi-host counterpart of ``tools/serving_chaos_probe.py``: spawns
+a task master plus N local CPU worker processes (each an
+ElasticTrainerLoop over a generation-fenced dispatcher with background
+membership heartbeats — the same worker the subprocess chaos test
+drives, ``tests/elastic_chaos_child.py``), hard-kills one mid-pass,
+and prints, with no accelerator and no test harness:
+
+* the generation transitions each survivor went through (G -> G+1),
+* kill-to-resumed-step latency per survivor (wall clock from the
+  SIGKILL to the first completed post-restart step) plus the
+  detect-to-ready ``paddle_elastic_resume_seconds`` observations,
+* the recovery counters (worker deaths, restarts, heartbeats) and the
+  master's final CLUSTER/STATS view — every chunk done, nothing
+  pending, nobody hung.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/multihost_chaos_probe.py [n_workers]
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_WORKERS = 3
+KILL_IDX = 1
+KILL_AT_STEP = 3
+N_SAMPLES = 240
+
+
+def main():
+    import numpy as np
+
+    from paddle_tpu.dataset import common
+    from paddle_tpu.distributed import (ElasticDataDispatcher,
+                                        MasterClient, MasterServer)
+
+    n_workers = int(sys.argv[1]) if len(sys.argv) > 1 else N_WORKERS
+    assert n_workers >= 3, "need N>=3 so survivors outnumber the dead"
+    tmp = tempfile.mkdtemp(prefix="multihost_chaos_probe_")
+
+    rs = np.random.RandomState(3)
+    X = rs.randn(N_SAMPLES, 4).astype("float32")
+    Y = (X.sum(1, keepdims=True) * 0.5).astype("float32")
+
+    def samples():
+        for i in range(N_SAMPLES):
+            yield (i, X[i].tolist(), Y[i].tolist())
+
+    common.convert(os.path.join(tmp, "ds"), samples, 40, "lin",
+                   max_chunk_bytes=1 << 10)
+    ds_glob = os.path.join(tmp, "ds", "lin-*")
+
+    srv = MasterServer(os.path.join(tmp, "snap"), timeout_sec=5,
+                       heartbeat_timeout_ms=1200)
+    client = MasterClient(srv.port)
+    n_chunks = ElasticDataDispatcher(client, ds_glob).register_dataset()
+
+    worker = os.path.join(REPO, "tests", "elastic_chaos_child.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs, outs = [], []
+    t_kill = None
+    try:
+        for idx in range(n_workers):
+            kill_at = KILL_AT_STEP if idx == KILL_IDX else 0
+            procs.append(subprocess.Popen(
+                [sys.executable, worker, REPO, str(srv.port), ds_glob,
+                 os.path.join(tmp, "ckpt_w%d" % idx),
+                 os.path.join(tmp, "out_w%d.json" % idx),
+                 str(idx), str(kill_at), str(n_workers)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        # watch for the kill so the latency clock starts at the death
+        while procs[KILL_IDX].poll() is None:
+            time.sleep(0.02)
+        t_kill = time.time()
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    survivors = []
+    for idx in range(n_workers):
+        if idx == KILL_IDX:
+            continue
+        with open(os.path.join(tmp, "out_w%d.json" % idx)) as f:
+            survivors.append(json.load(f))
+
+    stats = client.stats()
+    cluster = client.cluster()
+    srv.stop()
+
+    # -- report ----------------------------------------------------------
+    print("== multihost chaos report " + "=" * 40)
+    rows = []
+    for s in survivors:
+        kill_to_resumed = (s["resumed_at"][0] - t_kill
+                           if s["resumed_at"] else None)
+        rows.append({
+            "worker": s["worker"],
+            "generations": s["generations"],
+            "restarts": s["restarts"],
+            "kill_to_resumed_step_s":
+                None if kill_to_resumed is None
+                else round(kill_to_resumed, 3),
+            "detect_to_ready_s":
+                round(s["resume_seconds"]["sum"] /
+                      max(s["resume_seconds"]["count"], 1), 3),
+            "deaths_observed": s["deaths_observed"],
+            "final_loss": round(s["losses"][-1], 5),
+        })
+    print(json.dumps({
+        "n_workers": n_workers, "killed": "w%d" % KILL_IDX,
+        "kill_at_step": KILL_AT_STEP, "n_chunks": n_chunks,
+        "survivors": rows,
+        "master_stats": stats, "cluster": cluster,
+    }, indent=1))
+    print("== generation transitions " + "=" * 40)
+    for idx, out in enumerate(outs):
+        for line in out.splitlines():
+            if line.startswith(("BRINGUP", "RESUMED", "DONE")):
+                print("w%d| %s" % (idx, line))
+
+    # -- smoke assertions (exit non-zero if the layer is broken) ---------
+    assert procs[KILL_IDX].returncode == -9, \
+        "armed worker was not SIGKILLed"
+    for idx in range(n_workers):
+        if idx != KILL_IDX:
+            assert procs[idx].returncode == 0, outs[idx][-2000:]
+    for s in survivors:
+        assert max(s["generations"]) >= 2, s["generations"]
+        assert s["restarts"] >= 1
+        assert np.isfinite(s["losses"]).all()
+    assert stats["todo"] == 0 and stats["pending"] == 0
+    assert stats["done"] == n_chunks
+    assert cluster["deaths"] == 1
+    lat = [r["kill_to_resumed_step_s"] for r in rows
+           if r["kill_to_resumed_step_s"] is not None]
+    assert lat, "no survivor recorded a resumed step"
+    print("MULTIHOST CHAOS PROBE OK: %d/%d survived, generation %d, "
+          "kill-to-resumed-step %.2fs (max %.2fs), all %d chunks done"
+          % (n_workers - 1, n_workers, cluster["generation"],
+             min(lat), max(lat), n_chunks))
+
+
+if __name__ == "__main__":
+    main()
