@@ -105,6 +105,20 @@ elastic_heartbeat_interval_sec: default cadence of the membership
 elastic_max_restarts: how many teardown/rebuild cycles an
   ElasticTrainerLoop tolerates before raising ElasticRestartLimit —
   bounds a flapping cluster, like nonfinite_budget bounds divergence.
+
+compile_cache_dir: None (default) or a directory path. When set, every
+  single-host executor compile (train step or serving bucket) is also
+  serialized to disk (core/compile_cache.py), keyed by a stable digest
+  of the program content + feed/fetch signature + trace-time flags +
+  the jax/backend fingerprint, and a process restart deserializes the
+  XLA executable instead of re-tracing and re-compiling it — the
+  cold-start story for autoscaling replicas and restarting trainers.
+  Entries are sha256-manifested; a corrupt/truncated entry is
+  quarantined to ``corrupt_*`` and silently recompiled (a poisoned
+  cache dir can slow a start, never crash or mis-execute one). None:
+  no filesystem access at all — byte-identical legacy behavior.
+  Trust boundary: entries deserialize via jax's pickling executable
+  format, so point this only at directories you write.
 """
 
 import jax
@@ -135,6 +149,8 @@ _flags = {
     # on the single-process train path looks at these)
     "elastic_heartbeat_interval_sec": 2.0,
     "elastic_max_restarts": 3,
+    # deploy resilience (core/compile_cache.py; None = no disk access)
+    "compile_cache_dir": None,
 }
 
 # Observers called with the flag dict after every set_flags (the

@@ -1,0 +1,289 @@
+"""Persistent on-disk compile cache: restart = deserialize, not compile.
+
+The executor's in-memory ``_CacheEntry`` table dies with the process, so
+every replica start re-traces and re-compiles every bucket and every
+trainer restart recompiles the step — fine for a lab, fatal for an
+autoscaling fleet spinning replicas up under load. This module mirrors
+that table onto disk (config flag ``compile_cache_dir``): each entry is
+the ``jax.stages.Compiled`` executable serialized through
+``jax.experimental.serialize_executable`` plus a per-entry JSON manifest
+carrying its sha256 digest and the compile environment fingerprint.
+
+**Key stability.** The in-memory key leads with ``program._uid`` — a
+per-process counter, useless across restarts. The persistent key is a
+sha256 over the *content*: the program's serialized dict
+(core/serialization.py), the feed signature, fetch names, donation,
+every trace-time flag that keys the in-memory cache, the ingest specs,
+and the environment fingerprint (jax/jaxlib versions, backend platform,
+device kind and count, XLA_FLAGS). Same source program + same shapes +
+same flags + same machine shape ⇒ same digest; anything else is a clean
+miss, never a wrong executable.
+
+**Corruption tolerance** (the PR-3 checkpoint discipline): every load
+digest-verifies the blob against its manifest; a truncated, bit-flipped,
+or unpicklable entry — or one whose manifest is itself torn — is
+quarantined to ``corrupt_*`` (bounded evidence, like checkpoint
+quarantine) and the caller silently falls back to a normal compile. A
+poisoned cache dir can cost a cold start its fast path, never a crash
+and never a mis-executed step (the digest covers the whole blob; an
+environment mismatch is a skip, not a quarantine). The chaos hook
+``cache_corrupt`` (resilience/faults.py) injects exactly this failure.
+
+Trust boundary: the serialized executable format pickles XLA-internal
+objects, so (unlike the data-only ``__model__`` JSON) cache dirs and
+``compiled/`` artifact members must come from a writer you trust.
+
+Counters (always-on; every event here is a cold-start event, never a
+per-step cost): ``paddle_deploy_cache_hits_total`` /
+``_misses_total`` / ``_quarantined_total``.
+"""
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+
+import jax
+
+from ..observability import metrics as _metrics
+from ..utils import log as _log
+
+__all__ = ["PersistentCompileCache", "active_cache", "entry_digest",
+           "env_fingerprint", "serialize_compiled",
+           "deserialize_compiled"]
+
+CACHE_HITS = _metrics.REGISTRY.counter(
+    "paddle_deploy_cache_hits_total",
+    "Persistent compile-cache entries deserialized instead of compiled")
+CACHE_MISSES = _metrics.REGISTRY.counter(
+    "paddle_deploy_cache_misses_total",
+    "Persistent compile-cache lookups that fell through to an XLA "
+    "compile (absent, env-skewed, or quarantined entry)")
+CACHE_QUARANTINED = _metrics.REGISTRY.counter(
+    "paddle_deploy_cache_quarantined_total",
+    "Persistent compile-cache entries moved to corrupt_* after failing "
+    "digest verification or deserialization")
+
+
+class _CorruptEntry(Exception):
+    """Internal: entry present but failed verification/deserialization."""
+
+
+def env_fingerprint():
+    """Everything that silently changes what an XLA executable means:
+    a serialized binary deserialized into a different environment is a
+    MISS, not a candidate."""
+    try:
+        dev = jax.devices()[0]
+        platform, kind, n = dev.platform, \
+            getattr(dev, "device_kind", ""), len(jax.devices())
+    except RuntimeError:  # no backend yet
+        platform, kind, n = "none", "", 0
+    import jaxlib
+    return {
+        "jax": jax.__version__,
+        "jaxlib": getattr(jaxlib, "__version__", ""),
+        "platform": platform,
+        "device_kind": kind,
+        "n_devices": n,
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+    }
+
+
+def entry_digest(program, skey_parts):
+    """Stable cross-process digest for one executor cache entry.
+
+    ``skey_parts`` is the in-memory cache key minus its process-local
+    head (program uid/version), recorded on the entry by
+    ``Executor._prepare``; the program itself contributes through its
+    serialized content, so a program rebuilt by the same user code — or
+    re-read from an exported ``__model__`` — lands on the same digest.
+    """
+    from .serialization import program_to_dict
+    doc = {
+        "program": program_to_dict(program),
+        "sig": repr(skey_parts),
+        "env": env_fingerprint(),
+    }
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True).encode()).hexdigest()
+
+
+def serialize_compiled(compiled):
+    """One self-contained blob for a ``jax.stages.Compiled``: the PJRT
+    executable payload plus the arg/out pytree defs (which jax's
+    ``serialize`` hands back separately because pytrees aren't part of
+    its payload). Raises ValueError when the backend's compilation
+    doesn't support serialization."""
+    from jax.experimental import serialize_executable as _se
+    payload, in_tree, out_tree = _se.serialize(compiled)
+    return pickle.dumps((payload, in_tree, out_tree))
+
+
+def deserialize_compiled(blob):
+    from jax.experimental import serialize_executable as _se
+    payload, in_tree, out_tree = pickle.loads(blob)
+    return _se.deserialize_and_load(payload, in_tree, out_tree)
+
+
+def sha256_bytes(data):
+    return hashlib.sha256(data).hexdigest()
+
+
+def _write_atomic(path, data, mode="wb"):
+    # pid + thread id: two threads storing the same digest must not
+    # interleave into one temp file (the loser's os.replace publishes
+    # a whole file either way)
+    tmp = "%s.tmp.%d.%d" % (path, os.getpid(), threading.get_ident())
+    with open(tmp, mode) as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class PersistentCompileCache:
+    """Directory of serialized executables, one ``entry_<digest>.bin``
+    + ``entry_<digest>.json`` manifest per compile-cache entry."""
+
+    def __init__(self, dirname):
+        self.dirname = str(dirname)
+        self._serialize_unsupported = False  # log the first failure only
+
+    def _bin(self, digest):
+        return os.path.join(self.dirname, "entry_%s.bin" % digest)
+
+    def _meta(self, digest):
+        return os.path.join(self.dirname, "entry_%s.json" % digest)
+
+    def load(self, digest):
+        """The deserialized ``Compiled`` for ``digest``, or None.
+
+        Never raises: absent/env-skewed entries are plain misses;
+        corrupt entries (torn manifest, digest mismatch, unpicklable
+        blob, injected ``cache_corrupt`` fault) are quarantined and
+        reported as misses — the caller recompiles."""
+        bin_path, meta_path = self._bin(digest), self._meta(digest)
+        if not (os.path.exists(bin_path) and os.path.exists(meta_path)):
+            CACHE_MISSES.inc()
+            return None
+        try:
+            from ..resilience import faults as _faults
+            _faults.fire_point("cache_corrupt")
+            try:
+                with open(meta_path) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError) as e:
+                raise _CorruptEntry("unreadable manifest: %r" % (e,))
+            if meta.get("env") != env_fingerprint():
+                # a different jax/backend/topology is SKEW, not damage:
+                # leave the entry for the environment that wrote it
+                _log.structured("compile_cache_env_skew", digest=digest,
+                                entry_env=meta.get("env"))
+                CACHE_MISSES.inc()
+                return None
+            with open(bin_path, "rb") as f:
+                blob = f.read()
+            if sha256_bytes(blob) != meta.get("sha256"):
+                raise _CorruptEntry("blob digest mismatch")
+            compiled = deserialize_compiled(blob)
+        except Exception as e:
+            self._quarantine(digest, repr(e))
+            CACHE_MISSES.inc()
+            return None
+        CACHE_HITS.inc()
+        return compiled
+
+    def store(self, digest, compiled):
+        """Serialize + publish one entry (atomic per file; the manifest
+        lands last, so a crashed writer leaves an entry without a
+        manifest — a plain miss). Best-effort: serialization
+        unsupported on this backend, or a read-only dir, just means no
+        persistent cache."""
+        try:
+            blob = serialize_compiled(compiled)
+        except Exception as e:
+            if not self._serialize_unsupported:
+                self._serialize_unsupported = True
+                _log.structured("compile_cache_serialize_unsupported",
+                                error=repr(e))
+            return False
+        try:
+            os.makedirs(self.dirname, exist_ok=True)
+            _write_atomic(self._bin(digest), blob)
+            _write_atomic(
+                self._meta(digest),
+                json.dumps({"sha256": sha256_bytes(blob),
+                            "bytes": len(blob),
+                            "env": env_fingerprint()}).encode())
+        except OSError as e:
+            _log.structured("compile_cache_store_failed", digest=digest,
+                            error=repr(e))
+            return False
+        return True
+
+    def _quarantine(self, digest, reason):
+        """Move a corrupt entry aside (evidence, like checkpoint
+        quarantine) and bound the evidence to the newest few."""
+        moved = False
+        for path in (self._bin(digest), self._meta(digest)):
+            if not os.path.exists(path):
+                continue
+            dst = os.path.join(self.dirname,
+                               "corrupt_" + os.path.basename(path))
+            n = 0
+            while os.path.exists(dst):
+                n += 1
+                dst = os.path.join(self.dirname, "corrupt_%d_%s"
+                                   % (n, os.path.basename(path)))
+            try:
+                os.rename(path, dst)
+                moved = True
+            except OSError:
+                pass
+        if moved:
+            CACHE_QUARANTINED.inc()
+            _log.structured("compile_cache_quarantined", digest=digest,
+                            reason=reason)
+            try:
+                # bound the evidence to the newest 8 ENTRIES, pruning
+                # an entry's .bin and .json together (a stem-split
+                # prune would orphan a digestless blob or a blobless
+                # manifest — useless as evidence either way)
+                groups = {}
+                for fname in os.listdir(self.dirname):
+                    if not fname.startswith("corrupt_"):
+                        continue
+                    path = os.path.join(self.dirname, fname)
+                    stem = os.path.splitext(fname)[0]
+                    mtime, paths = groups.setdefault(stem, (0.0, []))
+                    groups[stem] = (max(mtime, os.path.getmtime(path)),
+                                    paths)
+                    paths.append(path)
+                for stem in sorted(groups,
+                                   key=lambda s: groups[s][0])[:-8]:
+                    for path in groups[stem][1]:
+                        os.remove(path)
+            except OSError:
+                pass
+
+
+_ACTIVE = {}
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active_cache():
+    """The PersistentCompileCache for the ``compile_cache_dir`` flag,
+    or None when the flag is unset (zero filesystem access)."""
+    from .. import config as _config
+    dirname = _config.get_flag("compile_cache_dir")
+    if not dirname:
+        return None
+    dirname = os.path.abspath(str(dirname))
+    with _ACTIVE_LOCK:
+        cache = _ACTIVE.get(dirname)
+        if cache is None:
+            cache = PersistentCompileCache(dirname)
+            _ACTIVE[dirname] = cache
+        return cache

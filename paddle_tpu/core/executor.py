@@ -27,6 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import compile_cache as _compile_cache
 from . import ingest as _ingest
 from . import registry
 from .framework import (Program, Variable, default_main_program,
@@ -105,12 +106,18 @@ def _ingest_spec(var, arriving_dtype, name, packed=False):
 
 class _CacheEntry:
     """One compile-cache slot: the jitted callable, io signature, and —
-    when telemetry AOT-compiled the step — the jax.stages.Compiled
-    executable (avoids the double-compile the jit call path would pay
-    after a cost-analysis compile)."""
+    when telemetry AOT-compiled the step, the persistent cache
+    deserialized it, or a serving artifact primed it — the
+    jax.stages.Compiled executable (avoids the double-compile the jit
+    call path would pay after a cost-analysis compile).
+
+    ``skey_parts`` is the in-memory cache key minus its process-local
+    head (program uid/version) — the stable half of the persistent
+    cache digest (core/compile_cache.py); ``pkey`` memoizes that digest
+    once computed."""
 
     __slots__ = ("fn", "read", "written", "needs_rng", "key_id", "aot",
-                 "aot_failed")
+                 "aot_failed", "skey_parts", "pkey")
 
     def __init__(self, fn, read, written, needs_rng, key_id):
         self.fn = fn
@@ -120,6 +127,8 @@ class _CacheEntry:
         self.key_id = key_id
         self.aot = None
         self.aot_failed = False
+        self.skey_parts = None
+        self.pkey = None
 
 
 def _lookup(env, name, op, block):
@@ -419,9 +428,19 @@ class Executor:
                                 donate_state, check_nan_inf, amp,
                                 nonfinite_guard, ingest_specs, packed_sig)
             entry = _CacheEntry(*built, key_id="k%d" % next(_KEY_IDS))
+            # the process-stable half of the persistent-cache digest
+            # (key[2:] drops program uid/version, which the program's
+            # serialized content replaces)
+            entry.skey_parts = key[2:]
             self._cache[key] = entry
         elif telemetry and count_cache:
             _CACHE_HITS.inc()
+        if entry.pkey is None and self.strategy is None and \
+                _config.get_flag("compile_cache_dir"):
+            # once per entry, only with the persistent cache armed:
+            # hash the program content + stable key into the on-disk key
+            entry.pkey = _compile_cache.entry_digest(program,
+                                                     entry.skey_parts)
 
         state_rw, state_ro = {}, {}
         for n in entry.written:
@@ -466,6 +485,51 @@ class Executor:
             count_cache=False)
         return entry.fn.lower(state_rw, state_ro, feed_arrays)
 
+    def cache_digest(self, program, feed=None, fetch_list=None, scope=None,
+                     donate_state=True):
+        """The process-stable persistent-cache digest of the EXACT
+        computation ``run`` would execute for these arguments (program
+        content + feed/fetch signature + trace-time flags + environment
+        fingerprint — core/compile_cache.py). The digest is what an AOT
+        serving artifact records per bucket, so a loader can prove
+        "this serialized executable IS the computation I would compile
+        here" before trusting it."""
+        entry, _, _, _ = self._prepare(program, feed, fetch_list, scope,
+                                       donate_state, count_cache=False)
+        if entry.pkey is None:
+            entry.pkey = _compile_cache.entry_digest(program,
+                                                     entry.skey_parts)
+        return entry.pkey
+
+    def prime_aot(self, program, feed, fetch_list, scope, compiled,
+                  expect_digest=None, donate_state=True):
+        """Install a deserialized ``jax.stages.Compiled`` as the AOT
+        executable for the cache entry these arguments resolve to —
+        the serving cold-start path: deserialize, don't compile.
+
+        When ``expect_digest`` is given it must equal this entry's
+        :meth:`cache_digest` (raises ValueError otherwise) — version
+        skew, flag drift, or a different topology therefore can't
+        install an executable that computes something else; callers
+        catch and fall back to the compile path. If the executable
+        turns out aval-incompatible anyway, ``run``'s existing AOT
+        fallback degrades to the jitted path at first call."""
+        entry, _, _, _ = self._prepare(program, feed, fetch_list, scope,
+                                       donate_state, count_cache=False)
+        if expect_digest is not None:
+            if entry.pkey is None:
+                entry.pkey = _compile_cache.entry_digest(
+                    program, entry.skey_parts)
+            if entry.pkey != expect_digest:
+                raise ValueError(
+                    "AOT executable digest %s does not match this "
+                    "executor's computation digest %s (program/flag/"
+                    "environment skew)" % (expect_digest[:12],
+                                           entry.pkey[:12]))
+        entry.aot = compiled
+        entry.aot_failed = False
+        return entry
+
     def _aot_compile(self, entry, state_rw, state_ro, feed_arrays):
         """Telemetry path for a compile-cache miss: AOT-compile the step
         (the jit call path would compile the same module again — the AOT
@@ -502,16 +566,36 @@ class Executor:
             program, feed, fetch_list, scope, donate_state)
         from .. import config as _config
         if entry.aot is None and not entry.aot_failed and \
-                self.strategy is None and _config.get_flag("telemetry"):
-            # telemetry on and the step not yet AOT-compiled (fresh
-            # miss, or the entry predates telemetry / came from a
-            # lower() call): compile AOT so cost analysis and the
-            # executed step share ONE XLA compilation
-            try:
-                self._aot_compile(entry, state_rw, state_ro, feed_arrays)
-            except Exception:
-                entry.aot = None
-                entry.aot_failed = True  # jit call path from here on
+                self.strategy is None and \
+                (entry.pkey is not None or _config.get_flag("telemetry")):
+            # entry.pkey doubles as the "persistent cache armed" gate
+            # (set in _prepare only when compile_cache_dir is on), so
+            # the all-defaults path pays exactly the one telemetry
+            # flag check it always did — no active_cache() call.
+            pcache = _compile_cache.active_cache() \
+                if entry.pkey is not None else None
+            if pcache is not None:
+                # restart fast path: deserialize the executable a past
+                # process compiled for this exact digest. load() never
+                # raises — a corrupt entry is quarantined and reported
+                # as a miss, and we fall through to a normal compile.
+                entry.aot = pcache.load(entry.pkey)
+            if entry.aot is None and \
+                    (pcache is not None or _config.get_flag("telemetry")):
+                # telemetry on (cost-analysis compile, reused for
+                # execution) or persistent cache armed (compile once,
+                # publish for the next process): AOT-compile the step
+                # so the executed step and the artifact share ONE XLA
+                # compilation
+                try:
+                    self._aot_compile(entry, state_rw, state_ro,
+                                      feed_arrays)
+                except Exception:
+                    entry.aot = None
+                    entry.aot_failed = True  # jit call path from here on
+                else:
+                    if pcache is not None and entry.pkey is not None:
+                        pcache.store(entry.pkey, entry.aot)
         if entry.aot is not None:
             try:
                 new_state, fetches, guards = entry.aot(

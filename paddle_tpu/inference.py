@@ -44,15 +44,26 @@ class InferenceEngine:
 # Keyed engine cache for the one-shot helper: repeated infer() calls on
 # the same (unmodified) export reuse the loaded params AND the compiled
 # program instead of paying a full model load + retrace per call. Keys
-# include the __model__ file's mtime/size so a re-export invalidates.
+# prefer the artifact's manifest.json digest — one content hash over
+# EVERY member, so a params-only or quant.json-only republish (which
+# leaves __model__ byte-identical) still invalidates. Legacy
+# manifest-less artifacts fall back to __model__ mtime/size, which is
+# the best a pre-integrity export can offer.
 _ENGINE_CACHE = collections.OrderedDict()
 _ENGINE_CACHE_MAX = 8
 _ENGINE_CACHE_LOCK = threading.Lock()
 
 
 def _engine_cache_key(model_dir, place):
-    path = model_dir if os.path.isfile(model_dir) \
-        else os.path.join(model_dir, "__model__")
+    if os.path.isdir(model_dir):
+        digest = _io.artifact_manifest_digest(model_dir)
+        if digest is not None:
+            return (os.path.abspath(model_dir), str(place), digest)
+        path = os.path.join(model_dir, "__model__")
+    else:
+        # merged single-file artifact: any republish rewrites the zip,
+        # so its own mtime/size covers every member
+        path = model_dir
     st = os.stat(path)
     return (os.path.abspath(model_dir), str(place), st.st_mtime_ns,
             st.st_size)

@@ -24,11 +24,16 @@ from .core.scope import global_scope
 from .observability import metrics as _metrics
 from .resilience import faults as _faults
 from .utils import log as _log
+# the artifact layout is defined ONCE in utils/merge_model.py
+from .utils.merge_model import (COMPILED_DIR as _COMPILED_DIR,
+                                MEMBERS as _ARTIFACT_CORE,
+                                SIDECAR_MEMBERS as _ARTIFACT_OPTIONAL)
 
 __all__ = ["save_params", "load_params", "save_persistables",
            "load_persistables", "save_checkpoint", "load_checkpoint",
            "load_checkpoint_meta", "verify_checkpoint",
-           "save_inference_model", "load_inference_model", "prune_program"]
+           "save_inference_model", "load_inference_model",
+           "verify_model_artifact", "prune_program"]
 
 # Recovery observability (always-on: these fire on rare events, never in
 # the per-step hot path).
@@ -46,6 +51,17 @@ _CKPT_VERIFY_SECONDS = _metrics.REGISTRY.histogram(
 
 _CKPT_RE = re.compile(r"checkpoint_(\d+)$")
 _MANIFEST = "manifest.json"
+
+# Inference-artifact members the manifest covers (same filename as the
+# checkpoint manifest, same sha256 discipline — PR-3 extended to the
+# deploy path): _ARTIFACT_CORE / _ARTIFACT_OPTIONAL / _COMPILED_DIR,
+# imported above from utils/merge_model.py (the layout's one home).
+# ``compiled/`` members (AOT-exported executables, serving/deploy.py)
+# are digested too but verified separately by their consumer, which
+# can fall back to a recompile instead of failing the whole load.
+
+# one-time legacy warnings, keyed by the caller-visible artifact path
+_LEGACY_WARNED = set()
 
 
 def _select_vars(program, predicate):
@@ -384,13 +400,99 @@ def prune_program(program, fetch_names):
     return new_prog
 
 
+def _artifact_members(dirname):
+    """Relative paths of the artifact files a manifest covers (core +
+    optional sidecars + compiled/ members actually present)."""
+    members = [m for m in _ARTIFACT_CORE + _ARTIFACT_OPTIONAL
+               if os.path.exists(os.path.join(dirname, m))]
+    cdir = os.path.join(dirname, _COMPILED_DIR)
+    if os.path.isdir(cdir):
+        members += sorted(_COMPILED_DIR + "/" + f
+                          for f in os.listdir(cdir)
+                          if os.path.isfile(os.path.join(cdir, f)))
+    return members
+
+
+def write_artifact_manifest(dirname):
+    """(Re)write the artifact's sha256 ``manifest.json`` — call after
+    any republish that rewrites members in place (a proper republish;
+    the engine-cache key and swap validation both trust the digest)."""
+    digests = {m: _sha256_file(os.path.join(dirname, m))
+               for m in _artifact_members(dirname)}
+    _write_json_atomic(os.path.join(dirname, _MANIFEST),
+                       {"kind": "inference_model", "digests": digests})
+
+
+def artifact_manifest_digest(dirname):
+    """sha256 of the manifest file itself — a single content key for
+    the whole artifact (params-only or quant-only republishes change
+    it; the ``__model__`` mtime/size never has to be trusted). None for
+    legacy manifest-less artifacts."""
+    path = os.path.join(dirname, _MANIFEST)
+    if not os.path.exists(path):
+        return None
+    return _sha256_file(path)
+
+
+def verify_model_artifact(dirname, skip_compiled=True):
+    """Digest-verify an inference-model artifact dir. Returns
+    (ok, reason). Legacy manifest-less dirs verify as ok ("legacy");
+    ``skip_compiled`` leaves ``compiled/`` members to their consumer
+    (ServingEngine re-verifies each blob and falls back to a recompile,
+    so a corrupt executable must not fail an otherwise-intact load)."""
+    if not os.path.isdir(dirname):
+        return False, "missing dir"
+    mpath = os.path.join(dirname, _MANIFEST)
+    if not os.path.exists(mpath):
+        if os.path.exists(os.path.join(dirname, "__model__")):
+            return True, "legacy (no manifest)"
+        return False, "no manifest and no __model__"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, "unreadable manifest: %r" % (e,)
+    digests = manifest.get("digests", {})
+    # a core/sidecar member present on disk but absent from the
+    # manifest is as suspect as a digest mismatch: a stray quant.json
+    # would otherwise be APPLIED unverified (silently wrong model)
+    for fn in _ARTIFACT_CORE + _ARTIFACT_OPTIONAL:
+        if fn not in digests and \
+                os.path.exists(os.path.join(dirname, fn)):
+            return False, "unmanifested file %s" % fn
+    for fn, want in sorted(digests.items()):
+        if skip_compiled and fn.startswith(_COMPILED_DIR + "/"):
+            continue
+        path = os.path.join(dirname, fn)
+        try:
+            digest = _sha256_file(path)
+        except OSError as e:
+            # deleted/unreadable between listing and hashing — still
+            # (False, reason), never a raw OSError out of a verifier
+            return False, "unreadable file %s: %r" % (fn, e)
+        if digest != want:
+            return False, "digest mismatch on %s" % fn
+    return True, "ok"
+
+
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
-                         main_program=None, scope=None, quantize=None):
+                         main_program=None, scope=None, quantize=None,
+                         export_compiled=False, export_buckets=None):
     """Export pruned program + params for inference (reference
     save_inference_model:223 — prunes to feed/fetch targets).
     ``quantize="int8"`` additionally rewrites the exported weights to
     per-output-channel int8 (serving/quant.py); load_inference_model
-    dequantizes transparently."""
+    dequantizes transparently.
+
+    ``export_compiled=True`` also AOT-compiles every serving bucket
+    (``export_buckets``, default the ``serving_buckets`` flag) and
+    embeds the serialized XLA executables under ``compiled/`` — a
+    ServingEngine cold start then deserializes instead of compiling
+    (serving/deploy.py; skew degrades back to the compile path).
+
+    Every exported member is sha256-digested into the artifact's
+    ``manifest.json`` (the PR-3 checkpoint integrity discipline);
+    ``load_inference_model`` verifies it before trusting the params."""
     from .core.framework import default_main_program
     program = main_program or default_main_program()
     program = prune_program(program, [v.name for v in target_vars])
@@ -406,6 +508,22 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     if quantize:
         from .serving import quant as _quant
         _quant.quantize_model_dir(dirname, program=program, dtype=quantize)
+    # a re-export must never inherit a previous export's AOT
+    # executables: their digests can't match the new program, so the
+    # manifest would bless megabytes of dead blobs and every cold
+    # start would pay counted fallbacks on an artifact that LOOKS
+    # AOT-enabled
+    stale = os.path.join(dirname, _COMPILED_DIR)
+    if os.path.isdir(stale):
+        import shutil
+        shutil.rmtree(stale, ignore_errors=True)
+    if export_compiled:
+        from .serving import deploy as _deploy
+        _deploy.export_compiled_buckets(
+            dirname, scope=scope if scope is not None else global_scope(),
+            buckets=export_buckets,
+            place=getattr(executor, "place", None))
+    write_artifact_manifest(dirname)
 
 
 def load_inference_model(dirname, executor, scope=None):
@@ -413,12 +531,38 @@ def load_inference_model(dirname, executor, scope=None):
     versioned JSON (data only — safe to load from untrusted model dirs,
     unlike pickle; reference ships a protobuf ProgramDesc the same way).
     ``dirname`` may also be a single merged-model FILE
-    (utils/merge_model.py), the capi/mobile deployment artifact."""
+    (utils/merge_model.py), the capi/mobile deployment artifact.
+    Artifacts with a ``manifest.json`` are digest-verified before the
+    params are trusted (corruption raises ValueError); legacy
+    manifest-less artifacts load with a one-time warning. ``compiled/``
+    members (AOT executables) are NOT loaded here — and note they
+    deserialize via pickle, so only ServingEngine consumes them, and
+    only from trusted artifacts."""
+    orig_path = dirname
     tmp_dir = None
     if os.path.isfile(dirname):
         from .utils.merge_model import unpack_merged_model
         dirname = tmp_dir = unpack_merged_model(dirname)
     try:
+        # Integrity first (PR-3 discipline extended to artifacts): a
+        # truncated params.npz or tampered quant.json must fail with a
+        # clear error, not a downstream decode crash or — worse — a
+        # silently wrong model. compiled/ members are exempt here (the
+        # engine falls back to a recompile for those).
+        if os.path.exists(os.path.join(dirname, _MANIFEST)):
+            ok, reason = verify_model_artifact(dirname, skip_compiled=True)
+            if not ok:
+                raise ValueError(
+                    "inference model artifact %r failed integrity "
+                    "verification: %s" % (orig_path, reason))
+        elif orig_path not in _LEGACY_WARNED:
+            _LEGACY_WARNED.add(orig_path)
+            _log.structured("artifact_legacy_no_manifest", dir=orig_path)
+            import warnings
+            warnings.warn(
+                "inference model %r has no manifest.json (pre-integrity "
+                "export) — loading unverified; re-export to add digests"
+                % (orig_path,), stacklevel=2)
         with open(os.path.join(dirname, "__model__")) as f:
             bundle = json.load(f)
         from .core.serialization import program_from_dict

@@ -55,6 +55,16 @@ site                          where / what
                               spec runs instead if armed that way). Only
                               the watchdog's abort escalation gets out —
                               the bounded-hang proof for step_deadline_sec
+``cache_corrupt``             PersistentCompileCache.load, before the
+                              manifest read — a raising spec is treated
+                              exactly like on-disk corruption: the entry
+                              is quarantined and the caller recompiles
+``swap_bad_artifact``         ServingEngine.swap_weights validation gate —
+                              the push is rejected (SwapRejectedError)
+                              with the prior weights untouched
+``swap_canary_fail``          ServingEngine.swap_weights, before the
+                              canary execution — simulates a push whose
+                              weights fail on real traffic shapes
 ============================  =============================================
 
 Actions: ``"raise"`` (raise ``exc``, default :class:`InjectedFault`),

@@ -32,15 +32,17 @@ failures and overload (fault sites ``serving_replica_fail`` /
 ``serving_replica_slow`` / ``serving_overload``).
 """
 
+from . import deploy  # noqa: F401
 from . import quant  # noqa: F401
 from . import resilience  # noqa: F401
 from .resilience import (ServingDeadlineError,  # noqa: F401
                          ServingTimeoutError, ServingUnavailableError,
                          ReplicaBreaker)
+from .deploy import SwapRejectedError  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 from .batcher import MicroBatcher, ServingOverloadError  # noqa: F401
 
 __all__ = ["ServingEngine", "MicroBatcher", "ServingOverloadError",
            "ServingDeadlineError", "ServingTimeoutError",
-           "ServingUnavailableError", "ReplicaBreaker", "quant",
-           "resilience"]
+           "ServingUnavailableError", "SwapRejectedError",
+           "ReplicaBreaker", "deploy", "quant", "resilience"]

@@ -1,0 +1,228 @@
+"""Deploy resilience: AOT-exported serving artifacts + hot weight swap.
+
+The reference deploy story is "restart the process and re-read the
+protobuf" — every replica start re-traces and re-compiles every bucket.
+This module makes deploys first-class:
+
+* :func:`export_compiled_buckets` — called by
+  ``io.save_inference_model(..., export_compiled=True)``: AOT-compiles
+  each serving bucket of the just-exported artifact and embeds the
+  serialized XLA executables under ``compiled/`` (one
+  ``bucket_<b>.bin`` per bucket + an ``index.json`` with per-blob
+  sha256 digests, the compile-environment fingerprint, and the
+  executor cache digest that proves "this executable IS the
+  computation you would compile"). A ServingEngine cold start then
+  *deserializes* instead of compiling; any skew — jax version, flags,
+  topology, corrupt blob — degrades to the normal compile path with a
+  counter, never an error.
+* :class:`SwapRejectedError` + the swap/rollback counters backing
+  ``ServingEngine.swap_weights`` (engine.py): a new weight push is
+  digest-verified, signature-checked, and canary-executed before the
+  atomic flip, and a post-flip error burst auto-rolls back to the
+  prior weights.
+
+Fault sites (resilience/faults.py): ``swap_bad_artifact`` (fires in
+swap validation), ``swap_canary_fail`` (fires before the canary run);
+together with ``cache_corrupt`` (core/compile_cache.py) they make the
+whole deploy layer chaos-testable — ``tools/deploy_probe.py`` drives
+all three headless.
+
+Metrics (always-on; deploys are rare events, never a per-request
+cost): ``paddle_deploy_aot_loads_total`` /
+``paddle_deploy_aot_fallbacks_total``,
+``paddle_deploy_swap_total`` / ``paddle_deploy_swap_rolled_back_total``
+(canary/validation rejections count as rollbacks — operationally both
+are "the push did not land"), ``paddle_deploy_cold_start_seconds``
+(engine construction through warmup), and the
+``paddle_deploy_swap_blackout_seconds`` histogram (the longest time
+any single replica was flip-locked — the per-replica serving blackout
+of a swap).
+"""
+
+import json
+import os
+
+import numpy as np
+
+import jax
+
+from .. import config as _config
+from ..core import compile_cache as _cc
+from ..core.executor import Executor
+from ..observability import metrics as _metrics
+from ..utils import log as _log
+from ..utils.merge_model import COMPILED_DIR as _COMPILED_DIR
+
+__all__ = ["SwapRejectedError", "export_compiled_buckets",
+           "load_compiled_index", "read_compiled_blob",
+           "synth_bucket_feed"]
+
+AOT_LOADS = _metrics.REGISTRY.counter(
+    "paddle_deploy_aot_loads_total",
+    "Serving buckets primed by deserializing an exported AOT "
+    "executable (no XLA compile)")
+AOT_FALLBACKS = _metrics.REGISTRY.counter(
+    "paddle_deploy_aot_fallbacks_total",
+    "Serving buckets that had an exported AOT executable but degraded "
+    "to the compile path (digest/env/device skew or corrupt blob)")
+SWAP_TOTAL = _metrics.REGISTRY.counter(
+    "paddle_deploy_swap_total",
+    "ServingEngine.swap_weights attempts")
+SWAP_ROLLED_BACK = _metrics.REGISTRY.counter(
+    "paddle_deploy_swap_rolled_back_total",
+    "Weight pushes that did not land: rejected by validation/canary "
+    "before the flip, or auto-rolled back by the post-swap failure "
+    "watch")
+COLD_START_SECONDS = _metrics.REGISTRY.gauge(
+    "paddle_deploy_cold_start_seconds",
+    "ServingEngine construction + warmup wall time (most recent "
+    "engine)")
+SWAP_BLACKOUT_SECONDS = _metrics.REGISTRY.histogram(
+    "paddle_deploy_swap_blackout_seconds",
+    "Longest single-replica flip-lock hold per swap/rollback (the "
+    "serving blackout a weight flip costs one replica)")
+
+_INDEX_FILE = "index.json"
+
+
+class SwapRejectedError(RuntimeError):
+    """A weight push was refused (artifact/signature/canary failure) or
+    auto-rolled back — the engine is still serving the prior weights."""
+
+
+def synth_bucket_feed(feature_specs, bucket):
+    """Zero feed for one bucket from ``{name: (feature_dims, dtype)}``
+    — THE feed synthesis shared by export and ``ServingEngine.warmup``
+    (one implementation, so the shapes+dtypes — and therefore the
+    executor cache signature and the recorded digest — can never
+    drift between export time and load time). None when any feature
+    dim is dynamic."""
+    feed = {}
+    for name, (dims, dtype) in feature_specs.items():
+        if any(d < 0 for d in dims):
+            return None
+        feed[name] = np.zeros((bucket,) + tuple(dims), dtype)
+    return feed
+
+
+def _bucket_feeds(block, feed_names, buckets):
+    """(bucket, feed) per synthesizable bucket of an exported program,
+    via :func:`synth_bucket_feed`. Skips buckets any dynamic non-batch
+    dim makes unsynthesizable; yields nothing when a feed var is
+    missing from the block."""
+    specs = {}
+    for name in feed_names:
+        var = block.var_or_none(name)
+        if var is None:
+            return
+        specs[name] = (tuple(var.shape or ())[1:],
+                       np.dtype(var.dtype))
+    for b in buckets:
+        feed = synth_bucket_feed(specs, b)
+        if feed is not None:
+            yield b, feed
+
+
+def export_compiled_buckets(dirname, scope, buckets=None, place=None):
+    """AOT-compile every serving bucket of the artifact at ``dirname``
+    and embed the serialized executables under ``compiled/``.
+
+    The program is re-read from the exported ``__model__`` (not the
+    in-memory pruned program) so the executor cache digest recorded per
+    bucket is computed over the *same* deserialized program a loading
+    engine will hold — digest equality at load time then proves program
+    + signature + trace-flags + environment all match. ``scope`` only
+    provides parameter shapes/dtypes for lowering; the executables are
+    weight-independent (weights are runtime inputs), which is what
+    makes them survive a hot weight swap.
+
+    Returns the list of buckets exported (empty when the backend can't
+    serialize executables — the artifact simply ships without
+    ``compiled/`` and engines compile as before)."""
+    if buckets is None:
+        buckets = _config.get_flag("serving_buckets")
+    buckets = tuple(sorted({int(b) for b in buckets}))
+    with open(os.path.join(dirname, "__model__")) as f:
+        bundle = json.load(f)
+    from ..core.serialization import program_from_dict
+    program = program_from_dict(bundle["program"])
+    feed_names = bundle["spec"]["feed_names"]
+    fetch_names = bundle["spec"]["fetch_names"]
+
+    exe = Executor(place=place)
+    # Pin the synthesized feeds to the device the export targets (the
+    # place's device, default device otherwise) so the executable is
+    # compiled FOR the device id the index records — a loading replica
+    # on a different device is then correctly gated into the compile
+    # fallback by _prime_bucket.
+    try:
+        dev = place.jax_device() if place is not None \
+            else jax.devices()[0]
+    except Exception:
+        dev = jax.devices()[0]
+    cdir = os.path.join(dirname, _COMPILED_DIR)
+    index = {"env": _cc.env_fingerprint(),
+             "device_id": dev.id,
+             "feed_names": list(feed_names),
+             "fetch_names": list(fetch_names),
+             "buckets": {}}
+    exported = []
+    for b, feed in _bucket_feeds(program.global_block(), feed_names,
+                                 buckets):
+        feed = {n: jax.device_put(a, dev) for n, a in feed.items()}
+        try:
+            lowered = exe.lower(program, feed=feed,
+                                fetch_list=fetch_names, scope=scope,
+                                donate_state=True)
+            blob = _cc.serialize_compiled(lowered.compile())
+        except Exception as e:
+            # backend without executable serialization (or a lowering
+            # this backend refuses to serialize): ship a plain artifact
+            _log.structured("aot_export_skipped", bucket=b,
+                            error=repr(e))
+            continue
+        digest = exe.cache_digest(program, feed=feed,
+                                  fetch_list=fetch_names, scope=scope)
+        os.makedirs(cdir, exist_ok=True)
+        fname = "bucket_%d.bin" % b
+        with open(os.path.join(cdir, fname), "wb") as f:
+            f.write(blob)
+        index["buckets"][str(b)] = {
+            "file": fname,
+            "sha256": _cc.sha256_bytes(blob),
+            "digest": digest,
+            "nbytes": len(blob),
+        }
+        exported.append(b)
+    if exported:
+        with open(os.path.join(cdir, _INDEX_FILE), "w") as f:
+            json.dump(index, f)
+        _log.structured("aot_export", dir=dirname, buckets=exported)
+    return exported
+
+
+def load_compiled_index(model_dir):
+    """The ``compiled/index.json`` dict of an artifact dir, or None
+    (plain artifact, merged file already unpacked elsewhere, torn
+    index). Never raises."""
+    if not os.path.isdir(model_dir):
+        return None
+    path = os.path.join(model_dir, _COMPILED_DIR, _INDEX_FILE)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def read_compiled_blob(model_dir, entry):
+    """One bucket blob, digest-verified against its index entry.
+    Returns bytes or raises ValueError (callers fall back to compile)."""
+    fname = os.path.basename(str(entry.get("file", "")))
+    path = os.path.join(model_dir, _COMPILED_DIR, fname)
+    with open(path, "rb") as f:
+        blob = f.read()
+    if _cc.sha256_bytes(blob) != entry.get("sha256"):
+        raise ValueError("AOT blob %s failed digest verification"
+                         % fname)
+    return blob

@@ -54,6 +54,7 @@ is armed.
 """
 
 import itertools
+import os
 import threading
 import time
 
@@ -63,16 +64,20 @@ import jax
 
 from .. import config as _config
 from .. import io as _io
+from ..core import compile_cache as _cc
 from ..core.executor import Executor
 from ..core.scope import Scope
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
 from ..resilience import faults as _faults
+from ..utils import log as _log
+from . import deploy as _deploy
 from . import resilience as _sres
+from .deploy import SwapRejectedError
 from .resilience import (BreakerProbe, ReplicaBreaker, ServingDeadlineError,
                          ServingTimeoutError, ServingUnavailableError)
 
-__all__ = ["ServingEngine"]
+__all__ = ["ServingEngine", "SwapRejectedError"]
 
 _REQUESTS = _metrics.REGISTRY.counter(
     "paddle_serving_requests_total",
@@ -132,7 +137,9 @@ class ServingEngine:
 
     def __init__(self, model_dir, buckets=None, replicas=1, devices=None,
                  warmup=True, place=None, breaker_failures=None,
-                 breaker_cooldown_ms=None, timeout=None):
+                 breaker_cooldown_ms=None, timeout=None,
+                 use_exported=True):
+        t_cold = time.perf_counter()
         if buckets is None:
             buckets = _config.get_flag("serving_buckets")
         self.buckets = tuple(sorted({int(b) for b in buckets}))
@@ -142,56 +149,109 @@ class ServingEngine:
 
         exe0 = Executor(place=place)
         scope0 = Scope()
-        (self.program, self.feed_names,
-         self.fetch_names) = _io.load_inference_model(
-             model_dir, exe0, scope=scope0)
-        block = self.program.global_block()
-        self._feed_specs = {}
-        for name in self.feed_names:
-            var = block.var_or_none(name)
-            if var is not None:
-                self._feed_specs[name] = (tuple(var.shape or ()),
-                                          np.dtype(var.dtype))
+        self.model_dir = model_dir
+        self._unpacked_dir = None
+        artifact_dir = model_dir
+        if os.path.isfile(model_dir):
+            # merged single-file artifact: unpack ONCE and keep the
+            # dir for the engine's lifetime (removed in close()), so
+            # the embedded compiled/ executables are servable too —
+            # load_inference_model's internal unpack is discarded
+            # after the params land
+            from ..utils.merge_model import unpack_merged_model
+            artifact_dir = self._unpacked_dir = \
+                unpack_merged_model(model_dir)
+        self._artifact_dir = artifact_dir
+        try:
+            (self.program, self.feed_names,
+             self.fetch_names) = _io.load_inference_model(
+                 artifact_dir, exe0, scope=scope0)
+            # the exact variable set an artifact loads — the
+            # shape/dtype signature swap_weights validates a new push
+            # against
+            self._param_names = tuple(sorted(scope0.var_names()))
+            block = self.program.global_block()
+            self._feed_specs = {}
+            for name in self.feed_names:
+                var = block.var_or_none(name)
+                if var is not None:
+                    self._feed_specs[name] = (tuple(var.shape or ()),
+                                              np.dtype(var.dtype))
 
-        if devices is None and replicas > 1:
-            devs = jax.devices()
-            devices = [devs[i % len(devs)] for i in range(replicas)]
-        self.replicas = []
-        if not devices:
-            self.replicas.append(_Replica(0, exe0, scope0, None))
-        else:
-            host = {n: np.asarray(v) for n, v in scope0.items()}
-            for i, dev in enumerate(devices):
-                scope = Scope()
-                for n, v in host.items():
-                    scope.set_var(n, jax.device_put(v, dev))
-                exe = exe0 if i == 0 else Executor(place=place)
-                self.replicas.append(_Replica(i, exe, scope, dev))
-        self._rr = itertools.count()
-        self._closed = False
-        self._engine_id = next(_ENGINE_SEQ)
+            if devices is None and replicas > 1:
+                devs = jax.devices()
+                devices = [devs[i % len(devs)] for i in range(replicas)]
+            self.replicas = []
+            if not devices:
+                self.replicas.append(_Replica(0, exe0, scope0, None))
+            else:
+                host = {n: np.asarray(v) for n, v in scope0.items()}
+                for i, dev in enumerate(devices):
+                    scope = Scope()
+                    for n, v in host.items():
+                        scope.set_var(n, jax.device_put(v, dev))
+                    exe = exe0 if i == 0 else Executor(place=place)
+                    self.replicas.append(_Replica(i, exe, scope, dev))
+            self._rr = itertools.count()
+            self._closed = False
+            self._engine_id = next(_ENGINE_SEQ)
 
-        if breaker_failures is None:
-            breaker_failures = _config.get_flag("serving_breaker_failures")
-        if breaker_cooldown_ms is None:
-            breaker_cooldown_ms = _config.get_flag(
-                "serving_breaker_cooldown_ms")
-        self.default_timeout = timeout
-        if breaker_failures:
-            self._breakers = [
-                ReplicaBreaker(rep.index, breaker_failures,
-                               float(breaker_cooldown_ms) / 1e3,
-                               label="e%d:%d" % (self._engine_id,
-                                                 rep.index))
-                for rep in self.replicas]
-        else:
-            self._breakers = None
-        self._probe = None           # BreakerProbe, started lazily
-        self._probe_feed = None      # (feed dict, bucket) from warmup
-        self._probe_lock = threading.Lock()
+            if breaker_failures is None:
+                breaker_failures = _config.get_flag(
+                    "serving_breaker_failures")
+            if breaker_cooldown_ms is None:
+                breaker_cooldown_ms = _config.get_flag(
+                    "serving_breaker_cooldown_ms")
+            self.default_timeout = timeout
+            if breaker_failures:
+                self._breakers = [
+                    ReplicaBreaker(rep.index, breaker_failures,
+                                   float(breaker_cooldown_ms) / 1e3,
+                                   label="e%d:%d" % (self._engine_id,
+                                                     rep.index))
+                    for rep in self.replicas]
+            else:
+                self._breakers = None
+            self._probe = None          # BreakerProbe, started lazily
+            self._probe_feed = None     # (feed dict, bucket) from warmup
+            self._probe_lock = threading.Lock()
 
-        if warmup:
-            self.warmup()
+            # deploy layer (engine-local; None/0 until a swap installs
+            # a watch — the default request path costs one None check)
+            self._swap_admin = threading.Lock()  # serializes swaps
+            self._swap_lock = threading.Lock()   # guards watch state
+            self._swap_watch = None
+            self._weights_version = 0
+            # {replica_index: values} a rollback could not install
+            # because the replica was wedged — applied under its lock
+            # before its next execution (None = nothing pending)
+            self._pending_restore = None
+            # True from the instant a watch failure DECIDES to roll
+            # back until the restore flip lands: concurrent failing
+            # requests see it and hold for the retry instead of
+            # surfacing the bad push (the version bump alone leaves a
+            # gap between the decision and the flip)
+            self._rollback_pending = False
+            # AOT-exported executables (io.save_inference_model(...,
+            # export_compiled=True)): warmup deserializes instead of
+            # compiling; absent/skewed/corrupt entries fall back
+            # silently
+            self._aot_index = _deploy.load_compiled_index(artifact_dir) \
+                if use_exported else None
+
+            if warmup:
+                self.warmup()
+        except Exception:
+            # a failed construction (bad manifest, warmup error) must
+            # not leak the unpacked merged-model copy — close() will
+            # never run; an autoscaler retrying a bad push would fill
+            # the temp filesystem one model copy per attempt
+            unpacked, self._unpacked_dir = self._unpacked_dir, None
+            if unpacked is not None:
+                import shutil
+                shutil.rmtree(unpacked, ignore_errors=True)
+            raise
+        _deploy.COLD_START_SECONDS.set(time.perf_counter() - t_cold)
 
     @property
     def max_bucket(self):
@@ -204,6 +264,20 @@ class ServingEngine:
                 return b
         return None
 
+    def _apply_pending_restore(self, rep):
+        """Install the restore values a rollback left pending for this
+        replica (it was wedged when the fleet flipped back). Caller
+        holds ``rep.lock``, so no batch can interleave."""
+        with self._swap_lock:
+            pending = self._pending_restore
+            vals = pending.pop(rep.index, None) if pending else None
+            if pending is not None and not pending:
+                self._pending_restore = None
+        if vals:
+            for name, val in vals.items():
+                rep.scope.set_var(name, val)
+            _log.structured("swap_flip_recovered", replica=rep.index)
+
     def _execute(self, rep, feed, bucket):
         _faults.fire_point("serving_replica_fail", index=rep.index)
         sig = tuple(sorted((n, a.shape) for n, a in feed.items()))
@@ -211,6 +285,8 @@ class ServingEngine:
             feed = {n: jax.device_put(a, rep.device)
                     for n, a in feed.items()}
         with rep.lock, _tracing.span("servingRun", bucket=bucket):
+            if self._pending_restore is not None:
+                self._apply_pending_restore(rep)
             _faults.fire_point("serving_replica_slow", index=rep.index)
             outs = rep.exe.run(self.program, feed=feed,
                                fetch_list=self.fetch_names,
@@ -369,7 +445,14 @@ class ServingEngine:
         now) checked *before* dispatch — an expired request raises
         ServingDeadlineError without ever occupying a device. On an
         execution failure the request fails over to the next healthy
-        replica; it only raises when no replica can take it."""
+        replica; it only raises when no replica can take it.
+
+        While a post-swap watch window is active (``swap_weights``),
+        every execution failure feeds the rollback trigger; the request
+        whose failure trips the rollback — and any concurrent request
+        whose failure raced the rollback flip — is transparently
+        retried once against the restored weights, so no caller ever
+        sees the bad push."""
         if self._closed:
             raise RuntimeError("ServingEngine is closed")
         if timeout is None:
@@ -382,54 +465,122 @@ class ServingEngine:
             _sres.DEADLINE_EXCEEDED.inc()
             raise ServingDeadlineError("deadline expired before dispatch")
         arrays, n, bucket = self._prepare(feed)
+        v0 = self._weights_version  # detect a mid-request weight flip
 
-        if self._breakers is None and timeout is None and deadline is None:
-            # PR-2 healthy fast path: no resilience bookkeeping at all.
+        if self._breakers is None and timeout is None and \
+                deadline is None and self._swap_watch is None and \
+                not self._rollback_pending:
+            # PR-2 healthy fast path: no resilience bookkeeping at
+            # all. A pending rollback routes through the slow path so
+            # a request dispatched onto the about-to-be-restored
+            # weights gets the transparent retry, not the bad push.
             rep = self.replicas[next(self._rr) % len(self.replicas)]
-            outs = self._run_once(rep, arrays, bucket, None)
-            return self._finish(outs, n, bucket)
+            try:
+                outs = self._run_once(rep, arrays, bucket, None)
+            except Exception:
+                if self._swap_watch is None and \
+                        not self._rollback_pending and \
+                        not self._swap_admin.locked() and \
+                        self._weights_version == v0:
+                    raise  # a plain failure, no swap anywhere near it
+                # a swap/rollback raced this dispatch (the guard saw
+                # pre-swap state, the execution saw the new weights):
+                # fall through to the slow path, which owns the
+                # watch/retry bookkeeping
+            else:
+                return self._finish(outs, n, bucket)
 
-        candidates = self._candidates()
-        if not candidates:
-            raise ServingUnavailableError(
-                "no healthy replica (all %d breakers open)"
-                % len(self.replicas))
         last_exc = None
         charged = False  # a breaker already blamed for THIS request
-        for pos, idx in enumerate(candidates):
-            if deadline is not None and time.monotonic() >= deadline:
-                _sres.DEADLINE_EXCEEDED.inc()
-                raise ServingDeadlineError(
-                    "deadline expired before dispatch")
-            rep = self.replicas[idx]
-            breaker = self._breakers[idx] if self._breakers else None
-            try:
-                outs = self._run_once(rep, arrays, bucket, timeout)
-            except Exception as exc:
-                last_exc = exc
-                if breaker is None:
-                    raise
-                hang = isinstance(exc, ServingTimeoutError)
-                # A request that already failed on another replica is
-                # almost certainly poison (bad feed content) — charge
-                # at most ONE breaker per request so a few bad requests
-                # can't open every breaker and black out healthy
-                # replicas. Hangs are always the replica's fault, and a
-                # half-open trial failure must always record (a breaker
-                # left dangling in half_open would never be probed or
-                # dispatched to again once another replica recovers).
-                if hang or not charged or breaker.state == "half_open":
-                    breaker.record_failure(hang=hang)
-                    charged = True
-                self._ensure_probe()
-                if pos + 1 == len(candidates):
-                    raise
-                _sres.FAILOVER.inc()
-                continue
-            if breaker is not None:
-                breaker.record_success()
-            return self._finish(outs, n, bucket)
-        raise last_exc  # pragma: no cover (loop always returns/raises)
+        for attempt in (0, 1):
+            candidates = self._candidates()
+            if not candidates:
+                raise ServingUnavailableError(
+                    "no healthy replica (all %d breakers open)"
+                    % len(self.replicas))
+            retry = False
+            for pos, idx in enumerate(candidates):
+                if deadline is not None and time.monotonic() >= deadline:
+                    _sres.DEADLINE_EXCEEDED.inc()
+                    raise ServingDeadlineError(
+                        "deadline expired before dispatch")
+                rep = self.replicas[idx]
+                breaker = self._breakers[idx] if self._breakers else None
+                try:
+                    outs = self._run_once(rep, arrays, bucket, timeout)
+                except Exception as exc:
+                    last_exc = exc
+                    final = breaker is None or \
+                        pos + 1 == len(candidates)
+                    # post-swap watch: ONE outcome per REQUEST (the
+                    # breaker's charge-at-most-once discipline) — a
+                    # poison request failing over across every replica
+                    # must count as a single failure, not burn the
+                    # whole consecutive budget and roll back a healthy
+                    # push. Noted only at the final candidate; True =
+                    # the prior weights were just restored, so this
+                    # request deserves one transparent retry instead
+                    # of surfacing the bad push to its caller.
+                    rolled = self._swap_note(False) \
+                        if final and self._swap_watch is not None \
+                        else False
+                    if breaker is not None:
+                        hang = isinstance(exc, ServingTimeoutError)
+                        # A request that already failed on another
+                        # replica is almost certainly poison (bad feed
+                        # content) — charge at most ONE breaker per
+                        # request so a few bad requests can't open
+                        # every breaker and black out healthy replicas.
+                        # Hangs are always the replica's fault, and a
+                        # half-open trial failure must always record (a
+                        # breaker left dangling in half_open would
+                        # never be probed or dispatched to again once
+                        # another replica recovers).
+                        if hang or not charged or \
+                                breaker.state == "half_open":
+                            breaker.record_failure(hang=hang)
+                            charged = True
+                        self._ensure_probe()
+                    if final:
+                        if not rolled and attempt == 0 and \
+                                (self._weights_version != v0 or
+                                 self._rollback_pending or
+                                 self._swap_admin.locked()):
+                            # A CONCURRENT request's rollback (or a
+                            # swap) replaced the weights this run
+                            # failed against — or its flip is still
+                            # in flight (decided, or admin lock
+                            # held; wait it out). Either way this
+                            # request deserves the same transparent
+                            # retry as the one that tripped the
+                            # rollback: no caller may see the bad
+                            # push.
+                            wait_until = time.monotonic() + \
+                                self.FLIP_LOCK_TIMEOUT
+                            if deadline is not None:
+                                # the wait must respect the caller's
+                                # deadline — the PR-5 contract bounds
+                                # run() by it, swap or no swap
+                                wait_until = min(wait_until, deadline)
+                            while (self._rollback_pending or
+                                   self._swap_admin.locked()) and \
+                                    time.monotonic() < wait_until:
+                                time.sleep(0.001)  # let the flip land
+                            rolled = self._weights_version != v0
+                        if rolled and attempt == 0:
+                            retry = True
+                            break
+                        raise
+                    _sres.FAILOVER.inc()
+                    continue
+                if breaker is not None:
+                    breaker.record_success()
+                if self._swap_watch is not None:
+                    self._swap_note(True)
+                return self._finish(outs, n, bucket)
+            if not retry:
+                break
+        raise last_exc
 
     # -- resilience ------------------------------------------------------
     def _ensure_probe(self):
@@ -467,6 +618,10 @@ class ServingEngine:
             probe, self._probe = self._probe, None
         if probe is not None:
             probe.stop()
+        unpacked, self._unpacked_dir = self._unpacked_dir, None
+        if unpacked is not None:
+            import shutil
+            shutil.rmtree(unpacked, ignore_errors=True)
         if self._breakers is not None:
             for breaker in self._breakers:
                 # drop this engine's health gauge children so redeploy
@@ -482,31 +637,435 @@ class ServingEngine:
     def __exit__(self, *exc):
         self.close()
 
+    # -- deploy: hot weight swap ----------------------------------------
+    @property
+    def weights_version(self):
+        """Monotonic counter of weight flips (initial load = 0; every
+        swap or rollback bumps it)."""
+        return self._weights_version
+
+    def _canary_feed(self):
+        """A warmed bucket's feed for the canary run (warmup recorded
+        one; otherwise synthesize the smallest bucket)."""
+        if self._probe_feed is not None:
+            return self._probe_feed
+        for b, feed in _deploy._bucket_feeds(
+                self.program.global_block(), self.feed_names,
+                self.buckets[:1]):
+            return feed, b
+        return None
+
+    # Bound on waiting for a replica's execution lock during a swap
+    # flip or canary: a replica wedged in a hung device execution
+    # holds its lock indefinitely (PR-5 leaves the stuck worker with
+    # it by design), and an unbounded acquire here would deadlock
+    # every future swap AND auto-rollback behind _swap_admin.
+    FLIP_LOCK_TIMEOUT = 30.0
+
+    def _run_canary(self, new_host):
+        """Execute one warmed bucket against the NEW weights in a
+        throwaway scope — same program, same compiled entry, zero
+        contact with live traffic's weights. Non-finite outputs or any
+        execution error reject the push. Runs on the first
+        breaker-healthy replica (a quarantined/wedged replica must not
+        stall the canary)."""
+        probe = self._canary_feed()
+        if probe is None:
+            _log.structured("swap_canary_skipped",
+                            reason="no synthesizable bucket feed")
+            return
+        feed, bucket = probe
+        cscope = Scope()
+        for name, val in new_host.items():
+            cscope.set_var(name, val)
+        rep = self.replicas[0]
+        if self._breakers is not None:
+            for i, breaker in enumerate(self._breakers):
+                if breaker.state == "closed":
+                    rep = self.replicas[i]
+                    break
+        if not rep.lock.acquire(timeout=self.FLIP_LOCK_TIMEOUT):
+            raise RuntimeError(
+                "replica %d execution lock not acquired within %.0fs "
+                "(wedged execution?) — canary could not run"
+                % (rep.index, self.FLIP_LOCK_TIMEOUT))
+        try:
+            with _tracing.span("swapCanary", bucket=bucket):
+                outs = rep.exe.run(self.program, feed=feed,
+                                   fetch_list=self.fetch_names,
+                                   scope=cscope)
+        finally:
+            rep.lock.release()
+        for out in outs:
+            arr = np.asarray(out)
+            if np.issubdtype(arr.dtype, np.floating) and \
+                    not np.all(np.isfinite(arr)):
+                raise ValueError("canary produced non-finite outputs")
+
+    def _flip(self, per_replica_values, skip_wedged=False,
+              prior_out=None):
+        """Install ``per_replica_values[rep.index]`` into each replica's
+        scope under its execution lock — every batch therefore runs
+        against exactly one weight version (the lock is what serializes
+        batches, PR-2), and replicas not currently being flipped keep
+        serving. Values are staged onto each replica's device BEFORE
+        any lock is taken, so the lock window is pointer flips, not
+        transfers. Replicas missing from ``per_replica_values`` are
+        skipped (partial-restore dicts).
+
+        A replica whose lock can't be had within FLIP_LOCK_TIMEOUT is
+        wedged in a hung execution: with ``skip_wedged`` (the rollback
+        path — the flip must make progress) it is skipped with a log;
+        otherwise the replicas already flipped are restored and
+        SwapRejectedError raised — a half-flipped fleet never serves.
+
+        Returns the prior per-replica values (the rollback state) and
+        observes the worst single-replica lock hold as the swap
+        blackout."""
+        worst = 0.0
+        # prior_out lets swap_weights hand the SAME dict to a
+        # pre-installed watch, so a rollback tripped mid-flip (it
+        # serializes behind _swap_admin, which the swap still holds)
+        # always sees the fully-populated restore state
+        prior = prior_out if prior_out is not None else {}
+        for rep in self.replicas:
+            vals = per_replica_values.get(rep.index)
+            if vals is None:
+                continue
+            if not rep.lock.acquire(timeout=self.FLIP_LOCK_TIMEOUT):
+                if skip_wedged:
+                    # leave the values PENDING: they are applied under
+                    # the replica's lock before its next execution
+                    # (_apply_pending_restore), so a recovered replica
+                    # can never serve a batch on the weights this flip
+                    # meant to replace
+                    with self._swap_lock:
+                        if self._pending_restore is None:
+                            self._pending_restore = {}
+                        self._pending_restore[rep.index] = vals
+                    _log.structured("swap_flip_skipped_wedged",
+                                    replica=rep.index)
+                    continue
+                self._flip(prior, skip_wedged=True)  # restore flipped
+                raise SwapRejectedError(
+                    "replica %d execution lock not acquired within "
+                    "%.0fs (wedged execution?) — swap aborted, prior "
+                    "weights restored" % (rep.index,
+                                          self.FLIP_LOCK_TIMEOUT))
+            try:
+                t0 = time.perf_counter()
+                prior[rep.index] = {n: rep.scope.find_var(n)
+                                    for n in vals}
+                for name, val in vals.items():
+                    rep.scope.set_var(name, val)
+                worst = max(worst, time.perf_counter() - t0)
+                if self._pending_restore is not None:
+                    # this flip just installed NEWER values: a stale
+                    # pending restore must not clobber them later
+                    with self._swap_lock:
+                        if self._pending_restore is not None:
+                            self._pending_restore.pop(rep.index, None)
+                            if not self._pending_restore:
+                                self._pending_restore = None
+            finally:
+                rep.lock.release()
+        _deploy.SWAP_BLACKOUT_SECONDS.observe(worst)
+        return prior
+
+    def _stage(self, new_host):
+        """Per-replica device copies of the new weights, transfers
+        completed up front (kept out of the flip's lock window)."""
+        staged = {}
+        for rep in self.replicas:
+            if rep.device is None:
+                staged[rep.index] = dict(new_host)
+            else:
+                vals = {n: jax.device_put(v, rep.device)
+                        for n, v in new_host.items()}
+                for val in vals.values():
+                    val.block_until_ready()
+                staged[rep.index] = vals
+        return staged
+
+    def swap_weights(self, model_dir, canary=True, watch_requests=50,
+                     watch_failures=3):
+        """Hot-swap the engine onto the weights in ``model_dir``
+        without dropping traffic. Returns the new weights version.
+
+        The push lands in three gates, each of which rejects with
+        :class:`SwapRejectedError` while the prior weights keep
+        serving untouched:
+
+        1. **validate** — artifact sha256 manifest verification, then a
+           full load into a staging scope and a parameter-set +
+           shape/dtype signature match against the live weights (the
+           program is NOT swapped: same architecture, new values — so
+           every compiled bucket survives the swap).
+        2. **canary** — one warmed-bucket execution against the new
+           weights in a throwaway scope (replica 0, under its batch
+           lock); errors or non-finite outputs reject the push.
+        3. **flip** — per replica, under its execution lock: stage the
+           new values onto the device first, then swap scope pointers
+           between drained batches. No batch ever sees mixed versions;
+           the blackout is the lock-held pointer flip
+           (``paddle_deploy_swap_blackout_seconds``).
+
+        After the flip a watch window arms: ``watch_failures``
+        CONSECUTIVE execution failures within the next
+        ``watch_requests`` requests auto-roll back to the prior
+        weights (counted in ``paddle_deploy_swap_rolled_back_total``),
+        and the request that trips the rollback retries transparently
+        against the restored weights. ``watch_requests=0`` disarms the
+        watch (the swap commits immediately)."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        with self._swap_admin:
+            _deploy.SWAP_TOTAL.inc()
+            try:
+                _faults.fire_point("swap_bad_artifact")
+                stage_scope = Scope()
+                # load_inference_model digest-verifies manifested
+                # artifacts before trusting the params (one hash per
+                # member — no separate verify pass) and raises the
+                # reason into this block
+                program2, feeds2, fetches2 = _io.load_inference_model(
+                    model_dir, Executor(), scope=stage_scope)
+                if list(feeds2) != list(self.feed_names) or \
+                        list(fetches2) != list(self.fetch_names):
+                    raise ValueError(
+                        "feed/fetch signature mismatch: push has "
+                        "%s -> %s, engine serves %s -> %s"
+                        % (feeds2, fetches2, self.feed_names,
+                           self.fetch_names))
+                new_host = {n: np.asarray(v)
+                            for n, v in stage_scope.items()}
+                if tuple(sorted(new_host)) != self._param_names:
+                    raise ValueError(
+                        "parameter set mismatch: push has %d vars, "
+                        "engine serves %d" % (len(new_host),
+                                              len(self._param_names)))
+                live = self.replicas[0].scope
+                for name, val in new_host.items():
+                    cur = live.find_var(name)
+                    if tuple(val.shape) != tuple(cur.shape) or \
+                            np.dtype(val.dtype) != np.dtype(cur.dtype):
+                        raise ValueError(
+                            "signature mismatch on %r: push %s/%s vs "
+                            "live %s/%s" % (name, val.shape, val.dtype,
+                                            tuple(cur.shape), cur.dtype))
+            except Exception as exc:
+                _deploy.SWAP_ROLLED_BACK.inc()
+                _log.structured("swap_rejected", stage="validate",
+                                model_dir=str(model_dir),
+                                error=repr(exc))
+                raise SwapRejectedError(
+                    "weight push rejected during validation: %s"
+                    % (exc,)) from exc
+            if canary:
+                try:
+                    _faults.fire_point("swap_canary_fail")
+                    self._run_canary(new_host)
+                except Exception as exc:
+                    _deploy.SWAP_ROLLED_BACK.inc()
+                    _log.structured("swap_rejected", stage="canary",
+                                    model_dir=str(model_dir),
+                                    error=repr(exc))
+                    raise SwapRejectedError(
+                        "canary run failed — push rejected: %s"
+                        % (exc,)) from exc
+            staged = self._stage(new_host)
+            # Install the watch BEFORE the flip: the instant any
+            # replica serves the new weights, a failure there must
+            # find the watch armed — installing it after the flip
+            # leaves a window where a bad push's failures take the
+            # fast path or surface to clients. The watch shares the
+            # ``prior`` dict the flip populates; a rollback tripped
+            # mid-flip blocks on _swap_admin (held here) until the
+            # flip is complete, so it always restores the full fleet.
+            prior = {}
+            with self._swap_lock:
+                self._swap_watch = None if not watch_requests else {
+                    "prior": prior,
+                    "remaining": int(watch_requests),
+                    "consecutive": 0,
+                    "threshold": max(1, int(watch_failures)),
+                    "version": self._weights_version + 1,
+                }
+            try:
+                self._flip(staged, prior_out=prior)
+            except SwapRejectedError:
+                # a wedged replica aborted the flip mid-way; the
+                # already-flipped replicas were restored — the push
+                # did not land
+                with self._swap_lock:
+                    self._swap_watch = None
+                _deploy.SWAP_ROLLED_BACK.inc()
+                _log.structured("swap_rejected", stage="flip",
+                                model_dir=str(model_dir))
+                raise
+            with self._swap_lock:
+                self._weights_version += 1
+                version = self._weights_version
+            _log.structured("swap_committed", model_dir=str(model_dir),
+                            version=version,
+                            watch_requests=int(watch_requests))
+            return version
+
+    def _swap_note(self, ok):
+        """Feed one request outcome to the post-swap watch. Returns
+        True when THIS failure tripped the auto-rollback (the caller
+        then retries once against the restored weights)."""
+        rollback_prior = rollback_version = None
+        with self._swap_lock:
+            watch = self._swap_watch
+            if watch is None:
+                return False
+            if ok:
+                watch["consecutive"] = 0
+            else:
+                watch["consecutive"] += 1
+            watch["remaining"] -= 1
+            if not ok and watch["consecutive"] >= watch["threshold"]:
+                rollback_prior = watch["prior"]
+                rollback_version = watch["version"]
+                self._swap_watch = None
+                self._rollback_pending = True
+            elif watch["remaining"] <= 0:
+                self._swap_watch = None
+                _log.structured("swap_watch_committed",
+                                version=watch["version"])
+        if rollback_prior is None:
+            return False
+        # Serialize the restore flip with swap_weights: a concurrent
+        # swap's flip must never interleave with this one (the two
+        # would leave replicas on MIXED versions — per-replica lock
+        # order differs), and if a newer swap already landed while we
+        # raced for the admin lock, its weights supersede the bad push
+        # — there is nothing left to restore.
+        try:
+            with self._swap_admin:
+                with self._swap_lock:
+                    if self._weights_version != rollback_version:
+                        _log.structured("swap_rollback_superseded",
+                                        watched_version=rollback_version,
+                                        current=self._weights_version)
+                        return False
+                # the restore must make progress past a wedged replica
+                # — its values stay PENDING and are installed under
+                # its lock before its next execution
+                # (_apply_pending_restore), so recovery can't
+                # resurrect the rejected weights
+                self._flip(rollback_prior, skip_wedged=True)
+                with self._swap_lock:
+                    self._weights_version += 1
+        finally:
+            with self._swap_lock:
+                self._rollback_pending = False
+        _deploy.SWAP_ROLLED_BACK.inc()
+        _log.structured("swap_rolled_back",
+                        restored_version=self._weights_version)
+        return True
+
     # -- startup ---------------------------------------------------------
+    def _prime_bucket(self, bucket, feed):
+        """Prime one bucket from the artifact's AOT-exported executable
+        instead of compiling it: verify the blob's sha256 against the
+        ``compiled/index.json`` entry, deserialize once, and install it
+        as each eligible replica's cache-entry executable — gated by the
+        executor cache digest, so version/flag/topology skew can never
+        install an executable that computes something else. Returns
+        {replica_index: executor cache entry} for the replicas primed
+        (warmup executes each and only THEN counts the AOT load — or a
+        fallback, if the call degraded); every prime miss here is a
+        counted fallback to the normal compile-warmup path."""
+        index = self._aot_index
+        entry = (index or {}).get("buckets", {}).get(str(bucket))
+        if entry is None:
+            return {}
+        dev_id = (index or {}).get("device_id")
+        compiled = None
+        primed = {}
+        for rep in self.replicas:
+            try:
+                if not entry.get("digest"):
+                    # no digest = no gate: never install an executable
+                    # the executor can't prove is THIS computation
+                    raise ValueError(
+                        "index entry for bucket %d carries no "
+                        "executor digest" % bucket)
+                if rep.device is not None and rep.device.id != dev_id:
+                    raise ValueError(
+                        "replica device %d != exported device %r"
+                        % (rep.device.id, dev_id))
+                if compiled is None:
+                    blob = _deploy.read_compiled_blob(
+                        self._artifact_dir, entry)
+                    compiled = _cc.deserialize_compiled(blob)
+                cache_entry = rep.exe.prime_aot(
+                    self.program, feed, self.fetch_names, rep.scope,
+                    compiled, expect_digest=entry["digest"])
+            except Exception as e:
+                _deploy.AOT_FALLBACKS.inc()
+                _log.structured("aot_prime_fallback", bucket=bucket,
+                                replica=rep.index, error=repr(e))
+                continue
+            # suppress the per-bucket compile counter for the primed
+            # execution (warmup re-counts honestly if the call
+            # degrades to a real compile)
+            rep.seen.add(tuple(sorted((n, a.shape)
+                               for n, a in feed.items())))
+            primed[rep.index] = cache_entry
+        return primed
+
     def warmup(self, example_feed=None):
-        """Compile every bucket on every replica ahead of traffic.
-        Feature dims come from the program's feed vars; a model with
-        dynamic (non-batch) dims needs ``example_feed`` — one example
-        per feed name, WITHOUT the batch dim. Returns the warmed
-        buckets. The smallest warmed bucket also becomes the breaker
-        probe's health-check execution."""
+        """Make every bucket on every replica ready ahead of traffic:
+        deserialize the artifact's AOT-exported executable when one
+        matches (cold start skips the XLA compile entirely), compile as
+        before otherwise. Feature dims come from the program's feed
+        vars; a model with dynamic (non-batch) dims needs
+        ``example_feed`` — one example per feed name, WITHOUT the batch
+        dim. Returns the warmed buckets. The smallest warmed bucket
+        also becomes the breaker probe's health-check execution."""
+        specs = {}
+        for name in self.feed_names:
+            if example_feed is not None and name in example_feed:
+                ex = np.asarray(example_feed[name])
+                specs[name] = (ex.shape, ex.dtype)
+                continue
+            spec = self._feed_specs.get(name)
+            if spec is None:
+                return []  # unknown feed var: nothing synthesizable
+            specs[name] = (tuple(spec[0][1:]), spec[1])
         warmed = []
         for b in self.buckets:
-            feed = {}
-            for name in self.feed_names:
-                if example_feed is not None and name in example_feed:
-                    ex = np.asarray(example_feed[name])
-                    feed[name] = np.zeros((b,) + ex.shape, ex.dtype)
-                    continue
-                spec = self._feed_specs.get(name)
-                if spec is None or any(d < 0 for d in spec[0][1:]):
-                    feed = None  # dynamic feature dim, can't synthesize
-                    break
-                feed[name] = np.zeros((b,) + tuple(spec[0][1:]), spec[1])
+            # the ONE feed synthesis shared with export (deploy.py) —
+            # same shapes + dtypes ⇒ the AOT digests recorded at
+            # export time match this engine's cache entries
+            feed = _deploy.synth_bucket_feed(specs, b)
             if feed is None:
-                continue
+                continue  # dynamic feature dim, can't synthesize
+            primed = self._prime_bucket(b, feed) \
+                if self._aot_index else {}
             for rep in self.replicas:
+                # primed replicas execute too: one batch through the
+                # deserialized executable validates it NOW — a
+                # call-incompatible blob degrades to the jit path at
+                # warmup, not as a compile stall on the first live
+                # request — and only a SURVIVING executable counts as
+                # an AOT load
                 self._execute(rep, feed, b)
+                centry = primed.get(rep.index)
+                if centry is None:
+                    continue
+                if centry.aot is not None and not centry.aot_failed:
+                    _deploy.AOT_LOADS.inc()
+                else:
+                    # the call degraded mid-execution: that WAS a jit
+                    # compile — the cold start must not report clean
+                    _deploy.AOT_FALLBACKS.inc()
+                    _BUCKET_COMPILES.labels(bucket=b).inc()
+                    _log.structured("aot_prime_call_fallback",
+                                    bucket=b, replica=rep.index)
             if not warmed:
                 self._probe_feed = (feed, b)
             warmed.append(b)

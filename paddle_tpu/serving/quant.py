@@ -151,6 +151,13 @@ def quantize_model_dir(dirname, program=None,
     np.savez(npz_path[:-len(".npz")], **arrays)
     with open(os.path.join(dirname, QUANT_META_FILE), "w") as f:
         json.dump({"version": 1, "dtype": "int8", "vars": quantized}, f)
+    # post-hoc quantization of an already-manifested artifact must
+    # refresh the digests (params.npz was rewritten in place) or every
+    # subsequent load fails integrity verification; inside
+    # save_inference_model there is no manifest yet — it lands after
+    from .. import io as _io
+    if os.path.exists(os.path.join(dirname, "manifest.json")):
+        _io.write_artifact_manifest(dirname)
     return sorted(quantized)
 
 

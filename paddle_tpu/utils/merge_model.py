@@ -4,9 +4,13 @@ file so the C API / mobile deployments ship a single artifact).
 
 A merged model is a plain zip of the inference dir's members
 (``__model__`` JSON, ``params.npz``, ``params.meta.json``, plus
-``quant.json`` for int8 exports) — data-only,
-safe to load from untrusted sources (no pickle), and loadable by both
-``io.load_inference_model`` and the C API's ``ptc_model_load``.
+``quant.json`` for int8 exports, the sha256 ``manifest.json``, and any
+``compiled/`` AOT-exported executables) — the data members are
+pickle-free and safe to load from untrusted sources, and the bundle is
+loadable by both ``io.load_inference_model`` and the C API's
+``ptc_model_load``. NOTE: ``compiled/`` members (serving/deploy.py)
+deserialize via jax's pickling executable format — they are only
+consumed by ServingEngine, and only from artifacts you trust.
 """
 
 import os
@@ -15,9 +19,28 @@ import zipfile
 
 __all__ = ["merge_inference_model", "unpack_merged_model"]
 
-_MEMBERS = ("__model__", "params.npz", "params.meta.json")
-# present only in int8-quantized exports (serving/quant.py)
-_OPTIONAL_MEMBERS = ("quant.json",)
+# THE artifact layout, defined once (io.py and serving/deploy.py
+# import these): core members every export writes, sidecar members the
+# manifest digests when present, the manifest itself, and the dir of
+# AOT-compiled bucket executables.
+MEMBERS = ("__model__", "params.npz", "params.meta.json")
+SIDECAR_MEMBERS = ("quant.json",)
+MANIFEST_MEMBER = "manifest.json"
+COMPILED_DIR = "compiled"
+
+_MEMBERS = MEMBERS
+_OPTIONAL_MEMBERS = SIDECAR_MEMBERS + (MANIFEST_MEMBER,)
+_COMPILED_PREFIX = COMPILED_DIR + "/"
+
+
+def _safe_compiled_member(name):
+    """True for a flat ``compiled/<file>`` member (zip-slip safe: no
+    nesting, no traversal, no absolute paths)."""
+    if not name.startswith(_COMPILED_PREFIX):
+        return False
+    base = name[len(_COMPILED_PREFIX):]
+    return bool(base) and "/" not in base and "\\" not in base \
+        and base not in (".", "..") and not base.startswith("..")
 
 
 def merge_inference_model(dirname, out_file):
@@ -35,6 +58,12 @@ def merge_inference_model(dirname, out_file):
         for m in _OPTIONAL_MEMBERS:
             if os.path.exists(os.path.join(dirname, m)):
                 z.write(os.path.join(dirname, m), m)
+        cdir = os.path.join(dirname, "compiled")
+        if os.path.isdir(cdir):
+            for f in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, f)
+                if os.path.isfile(path):
+                    z.write(path, _COMPILED_PREFIX + f)
     return out_file
 
 
@@ -52,5 +81,8 @@ def unpack_merged_model(path):
             z.extract(m, out)
         for m in _OPTIONAL_MEMBERS:
             if m in names:
+                z.extract(m, out)
+        for m in sorted(names):
+            if _safe_compiled_member(m):
                 z.extract(m, out)
     return out

@@ -1,0 +1,63 @@
+"""Subprocess child for the deploy chaos test (test_deploy.py).
+
+``python deploy_chaos_child.py <cache_dir>`` builds a deterministic
+6->3 linear net, arms the persistent compile cache at ``cache_dir``
+for the main-program step only, runs one executor step, and prints::
+
+    RESULT {"out_sha": ..., "hits": N, "misses": N, "quarantined": N}
+
+The parent runs this three times — cold (populates the cache), warm
+(must deserialize), and against a bit-flipped entry (must quarantine
+and recompile) — and asserts ``out_sha`` is identical every time and
+the exit code is always 0: a poisoned cache dir never crashes a
+process and never changes a result.
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main(cache_dir):
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+    from paddle_tpu.observability import metrics
+
+    main_prog, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main_prog, startup):
+        x = layers.data("x", shape=[6])
+        out = layers.fc(x, 3)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    scope = ptpu.global_scope()
+    for n in scope.var_names():
+        cur = np.asarray(scope.find_var(n))
+        scope.set_var(n, np.random.RandomState(7)
+                      .standard_normal(cur.shape).astype(cur.dtype))
+    feed = np.random.RandomState(1).randn(4, 6).astype("float32")
+
+    ptpu.config.set_flags(compile_cache_dir=cache_dir)
+    got, = exe.run(main_prog, feed={"x": feed}, fetch_list=[out])
+    got = np.asarray(got)
+
+    def counter(name):
+        return metrics.REGISTRY.counter(name).value
+
+    print("RESULT " + json.dumps({
+        "out_sha": hashlib.sha256(
+            np.ascontiguousarray(got).tobytes()).hexdigest(),
+        "hits": counter("paddle_deploy_cache_hits_total"),
+        "misses": counter("paddle_deploy_cache_misses_total"),
+        "quarantined": counter("paddle_deploy_cache_quarantined_total"),
+    }))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

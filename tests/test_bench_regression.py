@@ -67,6 +67,34 @@ class TestAnnotateRegression:
             {"metric": "m", "value": 95.0}, {"m": 100.0}, rel_tol=0.02)
         assert r["regressed"] is True
 
+    def test_regression_floor_suppresses_noise(self):
+        # µs-scale readings (swap blackout): both under the floor ->
+        # drift reported but never flagged; a reading ABOVE the floor
+        # is a real regression again
+        r = bench.annotate_regression(
+            {"metric": "swap_blackout_ms", "value": 0.045,
+             "higher_is_better": False, "regression_floor": 1.0},
+            {"swap_blackout_ms": 0.02})
+        assert r["regressed"] is False and r["drift"] < -0.10
+        r = bench.annotate_regression(
+            {"metric": "swap_blackout_ms", "value": 1.5,
+             "higher_is_better": False, "regression_floor": 1.0},
+            {"swap_blackout_ms": 0.02})
+        assert r["regressed"] is True
+
+    def test_lower_is_better_flags_increase(self):
+        # latency metrics (cold_start_ms / swap_blackout_ms): going UP
+        # is the regression, and drift is sign-flipped so + is always
+        # an improvement
+        r = bench.annotate_regression(
+            {"metric": "cold_start_ms", "value": 130.0,
+             "higher_is_better": False}, {"cold_start_ms": 100.0})
+        assert r["regressed"] is True and r["drift"] == -0.3
+        r = bench.annotate_regression(
+            {"metric": "cold_start_ms", "value": 70.0,
+             "higher_is_better": False}, {"cold_start_ms": 100.0})
+        assert r["regressed"] is False and r["drift"] == 0.3
+
     def test_round_trip_against_real_format(self):
         """The annotator reads the exact shape bench.main writes into
         the driver's BENCH_r*.json capture."""

@@ -1,0 +1,825 @@
+"""Deploy resilience (ISSUE 7): the persistent on-disk compile cache
+(restart = deserialize, not compile; corruption = quarantine +
+recompile, never a crash), AOT-exported serving artifacts (cold start
+skips the per-bucket XLA compiles), sha256 artifact manifests on
+save/load_inference_model, the manifest-digest infer() cache key, and
+hot weight swap with canary/validation gates and automatic rollback.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as ptpu
+from paddle_tpu import inference, io, layers
+from paddle_tpu.core import compile_cache as cc
+from paddle_tpu.observability import metrics
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving import (MicroBatcher, ServingEngine,
+                                SwapRejectedError, deploy)
+
+pytestmark = pytest.mark.deploy
+
+
+@pytest.fixture(autouse=True)
+def _deploy_flags():
+    """Every test starts with the deploy layer disarmed and leaves no
+    armed faults or cache flag behind."""
+    yield
+    ptpu.config.set_flags(compile_cache_dir=None)
+    faults.disarm()
+
+
+def _counter(name):
+    return metrics.REGISTRY.counter(name).value
+
+
+def _build(in_dim=6, out_dim=3):
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[in_dim])
+        out = layers.fc(x, out_dim)
+    return main, startup, out
+
+
+def _export(tmp_path, name, weights=None, export_compiled=False,
+            export_buckets=None, in_dim=6, out_dim=3):
+    """Export a linear net; ``weights`` maps param name -> value fn
+    ((shape, dtype) -> array) so two exports can differ ONLY in
+    weights (same program/names via the unique_name guard)."""
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        main, startup, out = _build(in_dim, out_dim)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        scope = ptpu.global_scope()
+        if weights is not None:
+            for n in scope.var_names():
+                cur = np.asarray(scope.find_var(n))
+                scope.set_var(n, weights(n, cur.shape, cur.dtype))
+        d = str(tmp_path / name)
+        io.save_inference_model(d, ["x"], [out], exe, main_program=main,
+                                export_compiled=export_compiled,
+                                export_buckets=export_buckets)
+        feed = np.random.RandomState(0).randn(8, in_dim).astype("float32")
+        want, = exe.run(main, feed={"x": feed}, fetch_list=[out])
+    return d, feed, np.asarray(want)
+
+
+def _const_weights(bias):
+    """W = 0, b = bias: every output row is exactly ``bias`` — the
+    weight-version oracle the swap tests read off each result."""
+    def fn(name, shape, dtype):
+        if len(shape) == 1:
+            return np.full(shape, bias, dtype)
+        return np.zeros(shape, dtype)
+    return fn
+
+
+# -- persistent compile cache -------------------------------------------
+
+class TestPersistentCompileCache:
+    def _run_once(self, feed, cache_dir):
+        """One executor step in a fresh process-like context: a new
+        Executor has an empty in-memory table, so the persistent cache
+        is the only thing standing between it and a recompile. The
+        flag is armed around the MAIN program only, so the startup
+        (initializer) program doesn't add its own cache entries."""
+        with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+            main, startup, out = _build()
+            exe = ptpu.Executor()
+            ptpu.config.set_flags(compile_cache_dir=None)
+            exe.run(startup)
+            scope = ptpu.global_scope()
+            for n in scope.var_names():
+                cur = np.asarray(scope.find_var(n))
+                scope.set_var(
+                    n, np.random.RandomState(7)
+                    .standard_normal(cur.shape).astype(cur.dtype))
+            ptpu.config.set_flags(compile_cache_dir=cache_dir)
+            got, = exe.run(main, feed={"x": feed}, fetch_list=[out])
+        return np.asarray(got)
+
+    def test_store_then_fresh_executor_deserializes(self, tmp_path):
+        cache_dir = str(tmp_path / "cc")
+        feed = np.random.RandomState(1).randn(4, 6).astype("float32")
+        h0, m0 = _counter("paddle_deploy_cache_hits_total"), \
+            _counter("paddle_deploy_cache_misses_total")
+        first = self._run_once(feed, cache_dir)
+        assert _counter("paddle_deploy_cache_misses_total") > m0
+        bins = [f for f in os.listdir(cache_dir)
+                if f.startswith("entry_") and f.endswith(".bin")]
+        assert len(bins) == 1  # one entry, with its manifest
+        assert os.path.exists(
+            os.path.join(cache_dir, bins[0][:-4] + ".json"))
+        second = self._run_once(feed, cache_dir)
+        assert _counter("paddle_deploy_cache_hits_total") == h0 + 1
+        np.testing.assert_array_equal(first, second)
+
+    def test_corrupt_entry_quarantined_and_recompiled(self, tmp_path):
+        cache_dir = str(tmp_path / "cc")
+        feed = np.random.RandomState(1).randn(4, 6).astype("float32")
+        first = self._run_once(feed, cache_dir)
+        bin_path = [os.path.join(cache_dir, f)
+                    for f in os.listdir(cache_dir)
+                    if f.endswith(".bin")][0]
+        blob = open(bin_path, "rb").read()
+        with open(bin_path, "wb") as f:
+            f.write(blob[: len(blob) // 2])  # truncated write
+        q0 = _counter("paddle_deploy_cache_quarantined_total")
+        second = self._run_once(feed, cache_dir)  # recompiles, no crash
+        np.testing.assert_array_equal(first, second)
+        assert _counter("paddle_deploy_cache_quarantined_total") == q0 + 1
+        assert any(f.startswith("corrupt_")
+                   for f in os.listdir(cache_dir))
+        # the recompile re-published a good entry: next one is a hit
+        h0 = _counter("paddle_deploy_cache_hits_total")
+        self._run_once(feed, cache_dir)
+        assert _counter("paddle_deploy_cache_hits_total") == h0 + 1
+
+    def test_torn_manifest_quarantined(self, tmp_path):
+        cache_dir = str(tmp_path / "cc")
+        feed = np.random.RandomState(1).randn(4, 6).astype("float32")
+        first = self._run_once(feed, cache_dir)
+        meta = [os.path.join(cache_dir, f)
+                for f in os.listdir(cache_dir)
+                if f.endswith(".json")][0]
+        with open(meta, "w") as f:
+            f.write('{"sha256": "tor')  # torn mid-write
+        q0 = _counter("paddle_deploy_cache_quarantined_total")
+        np.testing.assert_array_equal(first,
+                                      self._run_once(feed, cache_dir))
+        assert _counter("paddle_deploy_cache_quarantined_total") == q0 + 1
+
+    def test_env_skew_is_miss_not_quarantine(self, tmp_path):
+        cache_dir = str(tmp_path / "cc")
+        feed = np.random.RandomState(1).randn(4, 6).astype("float32")
+        self._run_once(feed, cache_dir)
+        meta_path = [os.path.join(cache_dir, f)
+                     for f in os.listdir(cache_dir)
+                     if f.endswith(".json")][0]
+        meta = json.load(open(meta_path))
+        meta["env"]["jax"] = "0.0.0-somebody-elses"
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        q0 = _counter("paddle_deploy_cache_quarantined_total")
+        h0 = _counter("paddle_deploy_cache_hits_total")
+        self._run_once(feed, cache_dir)
+        # skew: no hit, no quarantine — the entry belongs to the
+        # environment that wrote it and is still on disk
+        assert _counter("paddle_deploy_cache_hits_total") == h0
+        assert _counter("paddle_deploy_cache_quarantined_total") == q0
+        assert os.path.exists(meta_path)
+
+    def test_cache_corrupt_fault_site(self, tmp_path):
+        cache_dir = str(tmp_path / "cc")
+        feed = np.random.RandomState(1).randn(4, 6).astype("float32")
+        first = self._run_once(feed, cache_dir)
+        faults.arm("cache_corrupt")
+        q0 = _counter("paddle_deploy_cache_quarantined_total")
+        np.testing.assert_array_equal(first,
+                                      self._run_once(feed, cache_dir))
+        assert _counter("paddle_deploy_cache_quarantined_total") == q0 + 1
+
+    def test_flag_off_means_no_disk_access(self, tmp_path):
+        marker = tmp_path / "cc-untouched"
+        feed = np.random.RandomState(1).randn(4, 6).astype("float32")
+        self._run_once(feed, None)
+        assert not marker.exists()
+        assert cc.active_cache() is None
+
+    def test_different_shape_is_different_entry(self, tmp_path):
+        cache_dir = str(tmp_path / "cc")
+        self._run_once(np.zeros((4, 6), "float32"), cache_dir)
+        self._run_once(np.zeros((8, 6), "float32"), cache_dir)
+        bins = [f for f in os.listdir(cache_dir) if f.endswith(".bin")]
+        assert len(bins) == 2
+
+
+@pytest.mark.chaos
+def test_poisoned_cache_dir_survives_process_boundary(tmp_path):
+    """The acceptance-criteria shape, cross-process: warm the
+    persistent cache in one interpreter, corrupt the entry on disk,
+    and prove a NEW interpreter quarantines it and serves the exact
+    same result via recompile — exit 0, never a crash."""
+    cache_dir = str(tmp_path / "cc")
+    child = os.path.join(os.path.dirname(__file__),
+                         "deploy_chaos_child.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run_child():
+        proc = subprocess.run(
+            [sys.executable, child, cache_dir], env=env,
+            capture_output=True, text=True, timeout=180)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = [l for l in proc.stdout.splitlines()
+                if l.startswith("RESULT ")][0]
+        return json.loads(line[len("RESULT "):])
+
+    cold = run_child()
+    assert cold["misses"] >= 1 and cold["quarantined"] == 0
+    warm = run_child()
+    assert warm["hits"] >= 1
+    assert warm["out_sha"] == cold["out_sha"]
+    for f in os.listdir(cache_dir):
+        if f.endswith(".bin"):
+            path = os.path.join(cache_dir, f)
+            blob = open(path, "rb").read()
+            with open(path, "wb") as fh:  # bit-flip every 64th byte
+                fh.write(bytes(b ^ 0xFF if i % 64 == 0 else b
+                               for i, b in enumerate(blob)))
+    poisoned = run_child()
+    assert poisoned["quarantined"] >= 1
+    assert poisoned["out_sha"] == cold["out_sha"]  # recompiled, right
+
+
+# -- artifact manifests (satellite 1) -----------------------------------
+
+class TestArtifactManifest:
+    def test_export_writes_manifest_and_load_verifies(self, tmp_path):
+        d, feed, want = _export(tmp_path, "m")
+        manifest = json.load(open(os.path.join(d, "manifest.json")))
+        assert set(manifest["digests"]) \
+            >= {"__model__", "params.npz", "params.meta.json"}
+        ok, reason = io.verify_model_artifact(d)
+        assert ok, reason
+        with ptpu.scope_guard(ptpu.Scope()):
+            program, feeds, fetches = io.load_inference_model(
+                d, ptpu.Executor())
+        assert feeds == ["x"]
+
+    def test_tampered_params_fail_load(self, tmp_path):
+        d, _, _ = _export(tmp_path, "m")
+        path = os.path.join(d, "params.npz")
+        blob = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(blob[:-7] + bytes(7))
+        ok, reason = io.verify_model_artifact(d)
+        assert not ok and "params.npz" in reason
+        with pytest.raises(ValueError, match="integrity"):
+            io.load_inference_model(d, ptpu.Executor(),
+                                    scope=ptpu.Scope())
+
+    def test_legacy_artifact_loads_with_one_warning(self, tmp_path):
+        d, _, _ = _export(tmp_path, "m")
+        os.remove(os.path.join(d, "manifest.json"))
+        with pytest.warns(UserWarning, match="no manifest"):
+            io.load_inference_model(d, ptpu.Executor(),
+                                    scope=ptpu.Scope())
+        import warnings
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            io.load_inference_model(d, ptpu.Executor(),
+                                    scope=ptpu.Scope())
+        assert not [w for w in caught
+                    if "no manifest" in str(w.message)]
+
+    def test_posthoc_quantize_refreshes_manifest(self, tmp_path):
+        """quantize_model_dir rewrites params.npz in place — on an
+        already-manifested artifact it must refresh the digests or
+        every later load fails integrity verification."""
+        from paddle_tpu.serving import quant
+        d, feed, _ = _export(tmp_path, "m", in_dim=8, out_dim=4)
+        quant.quantize_model_dir(d)
+        ok, reason = io.verify_model_artifact(d)
+        assert ok, reason
+        with ptpu.scope_guard(ptpu.Scope()):
+            io.load_inference_model(d, ptpu.Executor())  # no raise
+
+    def test_unmanifested_sidecar_fails_verification(self, tmp_path):
+        """A stray quant.json dropped into a manifested artifact would
+        be APPLIED unverified (silently wrong model) — it must fail
+        verification like a digest mismatch."""
+        d, _, _ = _export(tmp_path, "m")
+        with open(os.path.join(d, "quant.json"), "w") as f:
+            f.write('{"version": 1, "dtype": "int8", "vars": {}}')
+        ok, reason = io.verify_model_artifact(d)
+        assert not ok and "quant.json" in reason
+        with pytest.raises(ValueError, match="integrity"):
+            io.load_inference_model(d, ptpu.Executor(),
+                                    scope=ptpu.Scope())
+
+    def test_merged_model_carries_manifest_and_compiled(self, tmp_path):
+        from paddle_tpu.utils.merge_model import (merge_inference_model,
+                                                  unpack_merged_model)
+        d, feed, want = _export(tmp_path, "m", export_compiled=True,
+                                export_buckets=(4,))
+        merged = merge_inference_model(d, str(tmp_path / "m.ptpu"))
+        out = unpack_merged_model(merged)
+        assert os.path.exists(os.path.join(out, "manifest.json"))
+        if os.path.isdir(os.path.join(d, "compiled")):
+            assert os.path.exists(
+                os.path.join(out, "compiled", "index.json"))
+        ok, reason = io.verify_model_artifact(out, skip_compiled=False)
+        assert ok, reason
+
+
+# -- AOT-exported serving artifacts -------------------------------------
+
+class TestAOTExport:
+    def test_export_compiled_writes_verified_index(self, tmp_path):
+        d, _, _ = _export(tmp_path, "m", export_compiled=True,
+                          export_buckets=(2, 4))
+        index = deploy.load_compiled_index(d)
+        if index is None:  # backend can't serialize: plain artifact
+            pytest.skip("backend does not serialize executables")
+        assert set(index["buckets"]) == {"2", "4"}
+        for entry in index["buckets"].values():
+            blob = deploy.read_compiled_blob(d, entry)
+            assert cc.sha256_bytes(blob) == entry["sha256"]
+
+    def test_cold_start_deserializes_not_compiles(self, tmp_path):
+        d, feed, want = _export(tmp_path, "m", export_compiled=True,
+                                export_buckets=(4, 8))
+        if deploy.load_compiled_index(d) is None:
+            pytest.skip("backend does not serialize executables")
+        loads0 = _counter("paddle_deploy_aot_loads_total")
+        falls0 = _counter("paddle_deploy_aot_fallbacks_total")
+        fam = metrics.REGISTRY._families[
+            "paddle_serving_bucket_compiles_total"]
+        compiles0 = sum(c.value for c in fam.children().values())
+        eng = ServingEngine(d, buckets=(4, 8), warmup=True)
+        assert _counter("paddle_deploy_aot_loads_total") == loads0 + 2
+        assert _counter("paddle_deploy_aot_fallbacks_total") == falls0
+        assert sum(c.value for c in fam.children().values()) == compiles0
+        got, = eng.run({"x": feed[:3]})
+        np.testing.assert_allclose(got, want[:3], rtol=1e-5, atol=1e-6)
+        assert metrics.REGISTRY.gauge(
+            "paddle_deploy_cold_start_seconds").value > 0.0
+        eng.close()
+
+    def test_corrupt_blob_degrades_to_compile(self, tmp_path):
+        d, feed, want = _export(tmp_path, "m", export_compiled=True,
+                                export_buckets=(4,))
+        index = deploy.load_compiled_index(d)
+        if index is None:
+            pytest.skip("backend does not serialize executables")
+        fname = index["buckets"]["4"]["file"]
+        path = os.path.join(d, "compiled", fname)
+        with open(path, "wb") as f:
+            f.write(b"garbage")
+        falls0 = _counter("paddle_deploy_aot_fallbacks_total")
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        assert _counter("paddle_deploy_aot_fallbacks_total") == falls0 + 1
+        got, = eng.run({"x": feed[:3]})  # compiled path, still right
+        np.testing.assert_allclose(got, want[:3], rtol=1e-5, atol=1e-6)
+        eng.close()
+
+    def test_digest_skew_degrades_to_compile(self, tmp_path):
+        d, feed, want = _export(tmp_path, "m", export_compiled=True,
+                                export_buckets=(4,))
+        index = deploy.load_compiled_index(d)
+        if index is None:
+            pytest.skip("backend does not serialize executables")
+        # a future jax / different flags would change the recorded
+        # digest: prime_aot must refuse, warmup must compile instead
+        index["buckets"]["4"]["digest"] = "0" * 64
+        with open(os.path.join(d, "compiled", "index.json"), "w") as f:
+            json.dump(index, f)
+        io.write_artifact_manifest(d)
+        falls0 = _counter("paddle_deploy_aot_fallbacks_total")
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        assert _counter("paddle_deploy_aot_fallbacks_total") == falls0 + 1
+        got, = eng.run({"x": feed[:3]})
+        np.testing.assert_allclose(got, want[:3], rtol=1e-5, atol=1e-6)
+        eng.close()
+
+    def test_reexport_clears_stale_compiled(self, tmp_path):
+        """Re-exporting into the same dir must drop the previous
+        export's AOT executables — their digests can't match the new
+        program, and the manifest must not bless dead blobs."""
+        d, _, _ = _export(tmp_path, "m", export_compiled=True,
+                          export_buckets=(4,))
+        had_compiled = deploy.load_compiled_index(d) is not None
+        d, feed, want = _export(tmp_path, "m")  # re-export, no AOT
+        if had_compiled:
+            assert not os.path.isdir(os.path.join(d, "compiled"))
+        assert deploy.load_compiled_index(d) is None
+        ok, reason = io.verify_model_artifact(d, skip_compiled=False)
+        assert ok, reason
+
+    def test_missing_digest_in_index_falls_back(self, tmp_path):
+        """An index entry with no executor digest has no gate — it
+        must never be installed (compile instead), even when the blob
+        sha256 is intact."""
+        d, feed, want = _export(tmp_path, "m", export_compiled=True,
+                                export_buckets=(4,))
+        index = deploy.load_compiled_index(d)
+        if index is None:
+            pytest.skip("backend does not serialize executables")
+        del index["buckets"]["4"]["digest"]
+        with open(os.path.join(d, "compiled", "index.json"), "w") as f:
+            json.dump(index, f)
+        io.write_artifact_manifest(d)
+        falls0 = _counter("paddle_deploy_aot_fallbacks_total")
+        eng = ServingEngine(d, buckets=(4,), warmup=True)
+        assert _counter("paddle_deploy_aot_fallbacks_total") == falls0 + 1
+        got, = eng.run({"x": feed[:3]})
+        np.testing.assert_allclose(got, want[:3], rtol=1e-5, atol=1e-6)
+        eng.close()
+
+    def test_use_exported_false_compiles(self, tmp_path):
+        d, feed, want = _export(tmp_path, "m", export_compiled=True,
+                                export_buckets=(4,))
+        loads0 = _counter("paddle_deploy_aot_loads_total")
+        eng = ServingEngine(d, buckets=(4,), warmup=True,
+                            use_exported=False)
+        assert _counter("paddle_deploy_aot_loads_total") == loads0
+        got, = eng.run({"x": feed[:3]})
+        np.testing.assert_allclose(got, want[:3], rtol=1e-5, atol=1e-6)
+        eng.close()
+
+
+# -- infer() cache key (satellite 2) ------------------------------------
+
+class TestInferCacheKey:
+    def test_params_only_republish_invalidates(self, tmp_path):
+        d, feed, _ = _export(tmp_path, "m",
+                             weights=_const_weights(1.0))
+        inference.clear_engine_cache()
+        out = inference.infer(d, {"x": feed[:2]})
+        np.testing.assert_allclose(out, np.full((2, 3), 1.0), atol=1e-6)
+        model_path = os.path.join(d, "__model__")
+        st = os.stat(model_path)
+        # republish ONLY the params (new bias), keeping __model__
+        # byte-identical AND mtime-identical — the old mtime/size key
+        # could never tell the difference
+        d2, _, _ = _export(tmp_path, "m2", weights=_const_weights(2.0))
+        shutil.copy(os.path.join(d2, "params.npz"),
+                    os.path.join(d, "params.npz"))
+        io.write_artifact_manifest(d)
+        os.utime(model_path, ns=(st.st_atime_ns, st.st_mtime_ns))
+        out = inference.infer(d, {"x": feed[:2]})
+        np.testing.assert_allclose(out, np.full((2, 3), 2.0), atol=1e-6)
+        inference.clear_engine_cache()
+
+    def test_unchanged_artifact_reuses_engine(self, tmp_path):
+        d, feed, _ = _export(tmp_path, "m")
+        inference.clear_engine_cache()
+        inference.infer(d, {"x": feed[:2]})
+        key = inference._engine_cache_key(d, None)
+        assert key == inference._engine_cache_key(d, None)
+        assert len(inference._ENGINE_CACHE) == 1
+        inference.infer(d, {"x": feed[:2]})
+        assert len(inference._ENGINE_CACHE) == 1
+        inference.clear_engine_cache()
+
+
+# -- hot weight swap with rollback --------------------------------------
+
+class TestWeightSwap:
+    def test_swap_serves_new_weights(self, tmp_path):
+        a, feed, _ = _export(tmp_path, "a", weights=_const_weights(1.0))
+        b, _, _ = _export(tmp_path, "b", weights=_const_weights(2.0))
+        eng = ServingEngine(a, buckets=(4,), warmup=True)
+        out, = eng.run({"x": feed[:2]})
+        np.testing.assert_allclose(out, np.full((2, 3), 1.0), atol=1e-6)
+        s0 = _counter("paddle_deploy_swap_total")
+        assert eng.swap_weights(b, watch_requests=0) == 1
+        assert eng.weights_version == 1
+        assert _counter("paddle_deploy_swap_total") == s0 + 1
+        out, = eng.run({"x": feed[:2]})
+        np.testing.assert_allclose(out, np.full((2, 3), 2.0), atol=1e-6)
+        eng.close()
+
+    def test_swap_rejects_corrupt_artifact(self, tmp_path):
+        a, feed, _ = _export(tmp_path, "a", weights=_const_weights(1.0))
+        b, _, _ = _export(tmp_path, "b", weights=_const_weights(2.0))
+        path = os.path.join(b, "params.npz")
+        blob = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(blob[:-5] + bytes(5))
+        eng = ServingEngine(a, buckets=(4,), warmup=True)
+        r0 = _counter("paddle_deploy_swap_rolled_back_total")
+        with pytest.raises(SwapRejectedError, match="validation"):
+            eng.swap_weights(b)
+        assert _counter("paddle_deploy_swap_rolled_back_total") == r0 + 1
+        assert eng.weights_version == 0
+        out, = eng.run({"x": feed[:2]})  # prior weights untouched
+        np.testing.assert_allclose(out, np.full((2, 3), 1.0), atol=1e-6)
+        eng.close()
+
+    def test_swap_rejects_signature_mismatch(self, tmp_path):
+        a, feed, _ = _export(tmp_path, "a", weights=_const_weights(1.0))
+        b, _, _ = _export(tmp_path, "b", out_dim=5)
+        eng = ServingEngine(a, buckets=(4,), warmup=True)
+        with pytest.raises(SwapRejectedError):
+            eng.swap_weights(b)
+        out, = eng.run({"x": feed[:2]})
+        np.testing.assert_allclose(out, np.full((2, 3), 1.0), atol=1e-6)
+        eng.close()
+
+    def test_swap_canary_rejects_nonfinite_weights(self, tmp_path):
+        a, feed, _ = _export(tmp_path, "a", weights=_const_weights(1.0))
+        bad, _, _ = _export(tmp_path, "bad",
+                            weights=_const_weights(np.nan))
+        eng = ServingEngine(a, buckets=(4,), warmup=True)
+        with pytest.raises(SwapRejectedError, match="canary"):
+            eng.swap_weights(bad)
+        out, = eng.run({"x": feed[:2]})
+        np.testing.assert_allclose(out, np.full((2, 3), 1.0), atol=1e-6)
+        eng.close()
+
+    def test_swap_fault_sites(self, tmp_path):
+        a, feed, _ = _export(tmp_path, "a", weights=_const_weights(1.0))
+        b, _, _ = _export(tmp_path, "b", weights=_const_weights(2.0))
+        eng = ServingEngine(a, buckets=(4,), warmup=True)
+        faults.arm("swap_bad_artifact")
+        with pytest.raises(SwapRejectedError, match="validation"):
+            eng.swap_weights(b)
+        faults.arm("swap_canary_fail")
+        with pytest.raises(SwapRejectedError, match="canary"):
+            eng.swap_weights(b)
+        faults.disarm()
+        out, = eng.run({"x": feed[:2]})
+        np.testing.assert_allclose(out, np.full((2, 3), 1.0), atol=1e-6)
+        eng.swap_weights(b, watch_requests=0)  # disarmed: lands fine
+        eng.close()
+
+    def test_bad_push_auto_rolls_back_zero_client_errors(self, tmp_path):
+        """The acceptance shape: a push that passes validation+canary
+        but fails on live traffic rolls itself back, and the request
+        that trips the rollback is retried transparently — its caller
+        sees a normal (old-weights) answer, never an error."""
+        a, feed, _ = _export(tmp_path, "a", weights=_const_weights(1.0))
+        b, _, _ = _export(tmp_path, "b", weights=_const_weights(2.0))
+        eng = ServingEngine(a, buckets=(4,), warmup=True)
+        eng.swap_weights(b, watch_requests=10, watch_failures=1)
+        r0 = _counter("paddle_deploy_swap_rolled_back_total")
+        # the new weights "fail in production": injected execution
+        # fault on the first post-swap request
+        faults.arm("serving_replica_fail")
+        out, = eng.run({"x": feed[:2]})  # NO exception reaches us
+        faults.disarm()
+        np.testing.assert_allclose(  # rolled back: old weights answer
+            out, np.full((2, 3), 1.0), atol=1e-6)
+        assert _counter("paddle_deploy_swap_rolled_back_total") == r0 + 1
+        assert eng.weights_version == 2  # flip + rollback flip
+        out, = eng.run({"x": feed[:2]})
+        np.testing.assert_allclose(out, np.full((2, 3), 1.0), atol=1e-6)
+        eng.close()
+
+    def test_watch_commits_after_quiet_window(self, tmp_path):
+        a, feed, _ = _export(tmp_path, "a", weights=_const_weights(1.0))
+        b, _, _ = _export(tmp_path, "b", weights=_const_weights(2.0))
+        eng = ServingEngine(a, buckets=(4,), warmup=True)
+        eng.swap_weights(b, watch_requests=3, watch_failures=1)
+        for _ in range(3):
+            eng.run({"x": feed[:2]})
+        assert eng._swap_watch is None  # committed
+        # a failure AFTER the watch window is an ordinary error again
+        faults.arm("serving_replica_fail")
+        with pytest.raises(faults.InjectedFault):
+            eng.run({"x": feed[:2]})
+        faults.disarm()
+        assert eng.weights_version == 1  # no rollback
+        out, = eng.run({"x": feed[:2]})
+        np.testing.assert_allclose(out, np.full((2, 3), 2.0), atol=1e-6)
+        eng.close()
+
+    def test_concurrent_traffic_swap_single_version_per_batch(
+            self, tmp_path):
+        """Satellite 3: submits in flight during swap_weights all
+        complete, every result reflects exactly one weight version
+        (rows are exactly 1.0 or exactly 2.0 — never a mix), zero
+        client-visible errors, and the recorded per-replica blackout
+        is bounded."""
+        a, feed, _ = _export(tmp_path, "a", weights=_const_weights(1.0))
+        b, _, _ = _export(tmp_path, "b", weights=_const_weights(2.0))
+        eng = ServingEngine(a, buckets=(4,), warmup=True)
+        mb = MicroBatcher(eng, max_delay_ms=2.0)
+        results, errors = [], []
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def client(i):
+            rng = np.random.RandomState(i)
+            while not stop.is_set():
+                try:
+                    fut = mb.submit(
+                        {"x": rng.randn(6).astype("float32")})
+                    row = np.asarray(fut.result(timeout=30))
+                except Exception as e:  # pragma: no cover - must not
+                    with lock:
+                        errors.append(e)
+                    return
+                with lock:
+                    results.append(row)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        version = eng.swap_weights(b, watch_requests=0)
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        mb.close()
+        eng.close()
+        assert not errors, errors[:3]
+        assert version == 1
+        assert len(results) > 20
+        ones = sum(bool(np.allclose(r, 1.0, atol=1e-5))
+                   for r in results)
+        twos = sum(bool(np.allclose(r, 2.0, atol=1e-5))
+                   for r in results)
+        assert ones + twos == len(results)  # no torn/mixed result
+        assert ones > 0 and twos > 0  # traffic really straddled it
+        hist = metrics.REGISTRY._families[
+            "paddle_deploy_swap_blackout_seconds"]._default()
+        assert hist.count >= 1
+        assert hist.vmax < 5.0  # pointer flips, not transfers
+
+    def test_poison_request_counts_once_against_watch(self, tmp_path):
+        """A single request that fails over across EVERY replica is
+        ONE failure for the post-swap watch (the breaker's
+        charge-at-most-once discipline) — a poison feed can't burn the
+        whole consecutive budget and roll back a healthy push."""
+        a, feed, _ = _export(tmp_path, "a", weights=_const_weights(1.0))
+        b, _, _ = _export(tmp_path, "b", weights=_const_weights(2.0))
+        eng = ServingEngine(a, buckets=(4,), replicas=2, warmup=True,
+                            breaker_failures=5)
+        eng.swap_weights(b, watch_requests=20, watch_failures=2)
+        # one poison request: fails on BOTH replicas
+        faults.arm("serving_replica_fail", times=2)
+        with pytest.raises(faults.InjectedFault):
+            eng.run({"x": feed[:2]})
+        assert eng.weights_version == 1  # no rollback from one request
+        assert eng._swap_watch is not None
+        assert eng._swap_watch["consecutive"] == 1
+        # a SECOND such request reaches the threshold: auto-rollback,
+        # transparently retried against the restored weights
+        faults.arm("serving_replica_fail", times=2)
+        out, = eng.run({"x": feed[:2]})
+        faults.disarm()
+        np.testing.assert_allclose(out, np.full((2, 3), 1.0), atol=1e-6)
+        assert eng.weights_version == 2
+        eng.close()
+
+    def test_merged_artifact_serves_embedded_aot(self, tmp_path):
+        from paddle_tpu.utils.merge_model import merge_inference_model
+        d, feed, want = _export(tmp_path, "m", export_compiled=True,
+                                export_buckets=(4,))
+        if deploy.load_compiled_index(d) is None:
+            pytest.skip("backend does not serialize executables")
+        merged = merge_inference_model(d, str(tmp_path / "m.ptpu"))
+        loads0 = _counter("paddle_deploy_aot_loads_total")
+        eng = ServingEngine(merged, buckets=(4,), warmup=True)
+        assert _counter("paddle_deploy_aot_loads_total") == loads0 + 1
+        got, = eng.run({"x": feed[:3]})
+        np.testing.assert_allclose(got, want[:3], rtol=1e-5, atol=1e-6)
+        unpacked = eng._unpacked_dir
+        assert unpacked and os.path.isdir(unpacked)
+        eng.close()
+        assert not os.path.exists(unpacked)  # close() cleans up
+
+    def test_concurrent_rollback_zero_client_errors(self, tmp_path):
+        """A push whose WEIGHTS fail in production, under concurrent
+        traffic: the tripping request retries, and every concurrent
+        request that raced the rollback flip retries too — zero
+        client-visible errors end to end."""
+        a, feed, _ = _export(tmp_path, "a", weights=_const_weights(1.0))
+        b, _, _ = _export(tmp_path, "b", weights=_const_weights(2.0))
+        eng = ServingEngine(a, buckets=(4,), warmup=True)
+        rep = eng.replicas[0]
+        bias = [n for n in eng._param_names
+                if np.asarray(rep.scope.find_var(n)).ndim == 1][0]
+        real_run = rep.exe.run
+
+        def run_failing_on_v2(program, feed=None, fetch_list=None,
+                              scope=None, **kw):
+            # weight-version-dependent failure: the bad push's bias is
+            # 2.0 — exactly what a canary-passing-but-broken model does
+            if scope is not None and scope.find_var(bias) is not None \
+                    and float(np.asarray(scope.find_var(bias))[0]) \
+                    == 2.0:
+                raise RuntimeError("weights broken in production")
+            return real_run(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope, **kw)
+
+        errors, results = [], []
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def client(i):
+            rng = np.random.RandomState(i)
+            while not stop.is_set():
+                try:
+                    out, = eng.run(
+                        {"x": rng.randn(2, 6).astype("float32")})
+                except Exception as e:  # pragma: no cover - must not
+                    with lock:
+                        errors.append(repr(e))
+                    return
+                with lock:
+                    results.append(np.asarray(out))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        rep.exe.run = run_failing_on_v2
+        try:
+            eng.swap_weights(b, canary=False, watch_requests=50,
+                             watch_failures=1)
+            time.sleep(0.5)  # traffic trips the watch and rolls back
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            rep.exe.run = real_run
+        assert not errors, errors[:3]
+        assert eng.weights_version == 2  # flip + auto-rollback
+        assert all(np.allclose(r, 1.0, atol=1e-5) for r in results)
+        eng.close()
+
+    def test_wedged_replica_gets_pending_restore_on_recovery(
+            self, tmp_path):
+        """A rollback that can't flip a wedged replica leaves its
+        restore PENDING; the replica's next execution installs it
+        before serving — recovery can never resurrect the rejected
+        weights."""
+        a, feed, _ = _export(tmp_path, "a", weights=_const_weights(1.0))
+        b, _, _ = _export(tmp_path, "b", weights=_const_weights(2.0))
+        eng = ServingEngine(a, buckets=(4,), warmup=True)
+        eng.FLIP_LOCK_TIMEOUT = 0.2
+        eng.swap_weights(b, watch_requests=10, watch_failures=1)
+        rep = eng.replicas[0]
+        rep.lock.acquire()  # wedge: a hung execution holds the lock
+        try:
+            # a request failure trips the watch; the rollback flip
+            # must skip the wedged replica but still count
+            assert eng._swap_note(False) is True
+            assert eng._pending_restore == {0: eng._pending_restore[0]}
+        finally:
+            rep.lock.release()  # the stuck run finally dies
+        out, = eng.run({"x": feed[:2]})  # applies the pending restore
+        np.testing.assert_allclose(out, np.full((2, 3), 1.0), atol=1e-6)
+        assert eng._pending_restore is None
+        eng.close()
+
+    def test_wedged_replica_aborts_forward_swap(self, tmp_path):
+        a, feed, _ = _export(tmp_path, "a", weights=_const_weights(1.0))
+        b, _, _ = _export(tmp_path, "b", weights=_const_weights(2.0))
+        eng = ServingEngine(a, buckets=(4,), warmup=True)
+        eng.FLIP_LOCK_TIMEOUT = 0.2
+        r0 = _counter("paddle_deploy_swap_rolled_back_total")
+        eng.replicas[0].lock.acquire()
+        try:
+            with pytest.raises(SwapRejectedError, match="wedged"):
+                eng.swap_weights(b, canary=False)
+        finally:
+            eng.replicas[0].lock.release()
+        assert _counter("paddle_deploy_swap_rolled_back_total") == r0 + 1
+        out, = eng.run({"x": feed[:2]})  # prior weights intact
+        np.testing.assert_allclose(out, np.full((2, 3), 1.0), atol=1e-6)
+        eng.close()
+
+    def test_swap_while_closed_raises(self, tmp_path):
+        a, _, _ = _export(tmp_path, "a")
+        eng = ServingEngine(a, buckets=(4,), warmup=False)
+        eng.close()
+        with pytest.raises(RuntimeError):
+            eng.swap_weights(a)
+
+
+# -- executor digest/prime units ----------------------------------------
+
+class TestExecutorPrime:
+    def test_cache_digest_stable_across_executors(self, tmp_path):
+        with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+            main, startup, out = _build()
+            exe = ptpu.Executor()
+            exe.run(startup)
+            feed = {"x": np.zeros((4, 6), "float32")}
+            d1 = exe.cache_digest(main, feed=feed,
+                                  fetch_list=[out.name])
+            d2 = ptpu.Executor().cache_digest(main, feed=feed,
+                                              fetch_list=[out.name])
+            assert d1 == d2
+            d3 = exe.cache_digest(
+                main, feed={"x": np.zeros((8, 6), "float32")},
+                fetch_list=[out.name])
+            assert d3 != d1
+
+    def test_prime_aot_digest_mismatch_raises(self, tmp_path):
+        with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+            main, startup, out = _build()
+            exe = ptpu.Executor()
+            exe.run(startup)
+            feed = {"x": np.zeros((4, 6), "float32")}
+            lowered = exe.lower(main, feed=feed, fetch_list=[out.name])
+            compiled = lowered.compile()
+            with pytest.raises(ValueError, match="digest"):
+                exe.prime_aot(main, feed, [out.name],
+                              ptpu.global_scope(), compiled,
+                              expect_digest="0" * 64)

@@ -235,18 +235,36 @@ class TestInferEngineCache:
         np.testing.assert_allclose(out1, out2)
 
     def test_reexport_invalidates(self, tmp_path):
-        from paddle_tpu import inference
+        from paddle_tpu import inference, io as pio
         d, feed, _ = _export(tmp_path)
         inference.clear_engine_cache()
         ptpu.inference.infer(d, {"x": feed[:2]})
         key1 = next(iter(inference._ENGINE_CACHE))
-        # a re-export bumps __model__ mtime -> different cache key
+        # an mtime-only touch with unchanged content is NOT a republish
+        # under the manifest-digest key (ISSUE 7 satellite): same key
         st = os.stat(os.path.join(d, "__model__"))
         os.utime(os.path.join(d, "__model__"),
                  ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
         ptpu.inference.infer(d, {"x": feed[:2]})
+        assert len(inference._ENGINE_CACHE) == 1
+        # a real republish (new params -> new manifest digest)
+        # invalidates even though __model__ is byte-identical
+        params_path = os.path.join(d, "params.npz")
+        with np.load(params_path) as z:
+            arrs = {k: z[k] for k in z.files}
+        k0 = sorted(arrs)[0]
+        arrs[k0] = arrs[k0] + 1.0
+        np.savez(params_path, **arrs)
+        pio.write_artifact_manifest(d)
+        ptpu.inference.infer(d, {"x": feed[:2]})
         assert len(inference._ENGINE_CACHE) == 2
         assert next(reversed(inference._ENGINE_CACHE)) != key1
+        # legacy manifest-less artifact: mtime/size fallback still
+        # invalidates on a re-export that touches __model__
+        os.remove(os.path.join(d, "manifest.json"))
+        n0 = len(inference._ENGINE_CACHE)
+        ptpu.inference.infer(d, {"x": feed[:2]})
+        assert len(inference._ENGINE_CACHE) == n0 + 1
         inference.clear_engine_cache()
 
 

@@ -1,0 +1,242 @@
+"""Deploy chaos probe: the zero-downtime deploy layer, headless.
+
+The deploy counterpart of ``tools/serving_chaos_probe.py``. One run
+drives the full lifecycle with no accelerator and no test harness:
+
+1. **export** — ``save_inference_model(..., export_compiled=True)``:
+   sha256 manifest + AOT-compiled per-bucket executables.
+2. **cold start** — a compile-path engine vs a deserialize-path engine
+   on the same artifact, both timed construct→warmup→first response;
+   the AOT engine must load (not compile) every bucket.
+3. **persistent cache** — one executor step published to
+   ``compile_cache_dir``, deserialized by a fresh executor, then the
+   entry is bit-flipped on disk: the next executor must quarantine it
+   and recompile to the identical result.
+4. **hot swap** — ``swap_weights`` to a new weight version under
+   concurrent client traffic (every response exactly one version,
+   zero errors), then an injected bad push (``swap_canary_fail``)
+   rejected at the canary, then a push that fails on live traffic and
+   auto-rolls back (``serving_replica_fail``) with the tripping
+   request transparently retried.
+
+Prints timings, the swap/rollback/cache recovery counters, and exits
+non-zero if any leg misbehaves.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/deploy_probe.py
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+BUCKETS = (1, 4, 16)
+N_THREADS = 4
+SWAP_TRAFFIC_SEC = 0.6
+
+
+def _export(tmp, name, scale=1.0, export_compiled=False):
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers, io
+    from paddle_tpu.models.smallnet import smallnet
+
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            img = layers.data("img", shape=[1, 28, 28])
+            label = layers.data("label", shape=[1], dtype="int64")
+            loss, acc, logits = smallnet(img, label)
+            probs = layers.softmax(logits)
+        exe = ptpu.Executor()
+        exe.run(startup)
+        scope = ptpu.global_scope()
+        rs = np.random.RandomState(7)
+        for n in sorted(scope.var_names()):
+            cur = np.asarray(scope.find_var(n))
+            scope.set_var(n, (scale * rs.standard_normal(cur.shape))
+                          .astype(cur.dtype))
+        d = os.path.join(tmp, name)
+        io.save_inference_model(d, ["img"], [probs], exe,
+                                main_program=main,
+                                export_compiled=export_compiled,
+                                export_buckets=BUCKETS)
+    return d
+
+
+def _cold_start(model_dir, use_exported):
+    from paddle_tpu.serving import ServingEngine
+    t0 = time.perf_counter()
+    eng = ServingEngine(model_dir, buckets=BUCKETS, warmup=True,
+                        use_exported=use_exported)
+    eng.run({"img": np.zeros((1, 1, 28, 28), "float32")})
+    return eng, time.perf_counter() - t0
+
+
+def _cache_leg(tmp, counter):
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+
+    cache_dir = os.path.join(tmp, "compile_cache")
+
+    def step():
+        with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+            main, startup = ptpu.Program(), ptpu.Program()
+            with ptpu.program_guard(main, startup):
+                x = layers.data("x", shape=[64])
+                h = layers.fc(x, 128, act="relu")
+                out = layers.fc(h, 10, act="softmax")
+            exe = ptpu.Executor()
+            ptpu.config.set_flags(compile_cache_dir=None)
+            exe.run(startup)
+            scope = ptpu.global_scope()
+            for n in sorted(scope.var_names()):
+                cur = np.asarray(scope.find_var(n))
+                scope.set_var(n, np.random.RandomState(3)
+                              .standard_normal(cur.shape)
+                              .astype(cur.dtype))
+            ptpu.config.set_flags(compile_cache_dir=cache_dir)
+            t0 = time.perf_counter()
+            got, = exe.run(main,
+                           feed={"x": np.zeros((8, 64), "float32")},
+                           fetch_list=[out])
+            dt = time.perf_counter() - t0
+            ptpu.config.set_flags(compile_cache_dir=None)
+        return np.asarray(got), dt
+
+    ref, t_compile = step()
+    warm, t_deserialize = step()
+    assert np.array_equal(ref, warm)
+    hits_before_poison = counter("paddle_deploy_cache_hits_total")
+    assert hits_before_poison >= 1, "warm step did not hit the cache"
+    for f in os.listdir(cache_dir):
+        if f.endswith(".bin"):
+            path = os.path.join(cache_dir, f)
+            blob = open(path, "rb").read()
+            with open(path, "wb") as fh:
+                fh.write(bytes(b ^ 0xFF if i % 64 == 0 else b
+                               for i, b in enumerate(blob)))
+    poisoned, t_poisoned = step()
+    assert np.array_equal(ref, poisoned), \
+        "poisoned cache changed a result"
+    assert counter("paddle_deploy_cache_quarantined_total") >= 1
+    return {"step_ms_first_process": round(t_compile * 1e3, 1),
+            "step_ms_restart_deserialize": round(t_deserialize * 1e3, 1),
+            "step_ms_poisoned_recompile": round(t_poisoned * 1e3, 1)}
+
+
+def main():
+    import tempfile
+
+    import paddle_tpu as ptpu  # noqa: F401
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import SwapRejectedError
+
+    tmp = tempfile.mkdtemp(prefix="deploy_probe_")
+
+    def counter(name):
+        return metrics.REGISTRY.counter(name).value
+
+    # 1+2: export, then compile-path vs deserialize-path cold start
+    d_a = _export(tmp, "model_a", scale=1.0, export_compiled=True)
+    d_b = _export(tmp, "model_b", scale=0.5)
+    d_nan = _export(tmp, "model_nan", scale=float("nan"))
+
+    eng_cold, t_compile_path = _cold_start(d_a, use_exported=False)
+    eng_cold.close()
+    loads0 = counter("paddle_deploy_aot_loads_total")
+    eng, t_aot_path = _cold_start(d_a, use_exported=True)
+    aot_loads = counter("paddle_deploy_aot_loads_total") - loads0
+    assert aot_loads == len(BUCKETS), \
+        "AOT cold start compiled instead of deserializing"
+
+    # 3: persistent compile cache + corruption quarantine
+    cache_report = _cache_leg(tmp, counter)
+
+    # 4a: hot swap under concurrent traffic
+    results, errors = [], []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(tid):
+        rs = np.random.RandomState(tid)
+        while not stop.is_set():
+            try:
+                out, = eng.run(
+                    {"img": rs.randn(2, 1, 28, 28).astype("float32")})
+                with lock:
+                    results.append(np.asarray(out))
+            except Exception as exc:  # any client-visible error fails
+                with lock:
+                    errors.append(repr(exc))
+                return
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    time.sleep(SWAP_TRAFFIC_SEC / 2)
+    t0 = time.perf_counter()
+    eng.swap_weights(d_b, watch_requests=0)
+    t_swap = time.perf_counter() - t0
+    time.sleep(SWAP_TRAFFIC_SEC / 2)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+
+    # 4b: injected bad artifact — rejected at the canary, still serving
+    rolled0 = counter("paddle_deploy_swap_rolled_back_total")
+    rejected = False
+    try:
+        eng.swap_weights(d_nan)  # NaN weights: canary must catch
+    except SwapRejectedError:
+        rejected = True
+    assert rejected, "NaN push landed"
+
+    # 4c: push that fails on live traffic — auto-rollback, the tripping
+    # request transparently retried (zero client-visible errors)
+    eng.swap_weights(d_a, watch_requests=10, watch_failures=1)
+    faults.arm("serving_replica_fail")
+    out, = eng.run({"img": np.zeros((1, 1, 28, 28), "float32")})
+    faults.disarm()
+    rollbacks = counter("paddle_deploy_swap_rolled_back_total") - rolled0
+    assert rollbacks == 2, rollbacks  # canary reject + traffic rollback
+    eng.close()
+
+    blackout = metrics.REGISTRY.histogram(
+        "paddle_deploy_swap_blackout_seconds").labels()
+
+    print("== deploy report " + "=" * 49)
+    print(json.dumps({
+        "cold_start_ms": {
+            "compile_path": round(t_compile_path * 1e3, 1),
+            "aot_deserialize_path": round(t_aot_path * 1e3, 1),
+            "aot_buckets_loaded": int(aot_loads),
+        },
+        "compile_cache": cache_report,
+        "swap": {
+            "swap_wall_ms": round(t_swap * 1e3, 1),
+            "blackout_ms_max": round(blackout.vmax * 1e3, 3),
+            "responses_during_swap": len(results),
+            "client_errors": errors,
+            "canary_rejected_nan_push": rejected,
+            "auto_rollbacks": int(rollbacks),
+        },
+    }, indent=1))
+    print("== recovery counters " + "=" * 45)
+    for line in metrics.REGISTRY.expose_text().splitlines():
+        if line.startswith("paddle_deploy_"):
+            print(line)
+
+
+if __name__ == "__main__":
+    main()
