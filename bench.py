@@ -647,6 +647,107 @@ def bench_deploy(on_accel):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_generation(on_accel):
+    """Autoregressive generation serving latencies (ISSUE 9), under the
+    regression tripwire:
+
+    * ``decode_tokens_per_sec`` — aggregate KV-cached decode throughput
+      at full slot occupancy (higher is better).
+    * ``time_to_first_token_ms`` — admit->first-token (prefill) on a
+      warm session; lower is better.
+    * ``inter_token_ms`` — median decode-step latency; lower is better.
+
+    Latency metrics carry ``higher_is_better: false`` plus a noise
+    floor (like ``swap_blackout_ms``): CPU scheduler jitter at the
+    millisecond scale must not trip the wire."""
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+    from paddle_tpu.models.transformer import (transformer_lm_generate,
+                                               transformer_lm_session)
+    from paddle_tpu.serving.generation import GenerationSession
+
+    vocab = 1024 if on_accel else 64
+    kw = dict(d_model=512, num_heads=8, d_ff=2048, num_layers=4) \
+        if on_accel else dict(d_model=64, num_heads=2, d_ff=128,
+                              num_layers=2)
+    steps = 64 if on_accel else 32
+    slots = 8 if on_accel else 4
+    max_len = 2 * steps
+    suffix = "" if on_accel else "_cpu_smoke"
+
+    # weights via the generate program's own startup (shared names)
+    with ptpu.unique_name.guard():
+        main_prog, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main_prog, startup):
+            anchor = layers.data("anchor", shape=[1], dtype="int32")
+            transformer_lm_generate(anchor, vocab_size=vocab,
+                                    max_len=max_len, beam_size=1,
+                                    **kw)
+    exe = ptpu.Executor()
+    exe.run(startup)
+
+    spec = transformer_lm_session(vocab, max_len=max_len, slots=slots,
+                                  cache_len=max_len,
+                                  prompt_buckets=(8,), **kw)
+    sess = GenerationSession(spec)
+    rs = np.random.RandomState(0)
+
+    def fill():
+        return [sess.admit(list(rs.randint(2, vocab, 4)))[0]
+                for _ in range(slots - len(sess.active_slots()))]
+
+    fill()                      # warm: prefill + decode compiles
+    sess.step()
+    for s in sess.active_slots():
+        sess.retire(s)
+
+    ttft = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        slot, _ = sess.admit([0])
+        ttft.append((time.perf_counter() - t0) * 1e3)
+        sess.retire(slot)
+    fill()
+    step_ms = []
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        t1 = time.perf_counter()
+        sess.step()
+        step_ms.append((time.perf_counter() - t1) * 1e3)
+    dt = time.perf_counter() - t0
+    tok_per_sec = slots * steps / dt
+    stats = sess.compile_stats()
+    if stats["compiles"] != 2:
+        raise RuntimeError(
+            "generation shape set not closed: %d compiles for 1 "
+            "prompt bucket + 1 decode shape" % stats["compiles"])
+
+    return [{
+        "metric": "decode_tokens_per_sec" + suffix,
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/sec (aggregate, %d slots)" % slots,
+        "vs_baseline": 1.0,  # no reference analog; tripwire-only
+        "slots": slots,
+        "steps": steps,
+    }, {
+        "metric": "time_to_first_token_ms" + suffix,
+        "value": round(float(np.median(ttft)), 2),
+        "unit": "ms admit->first token (warm)",
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        # prefill is a single small-batch step; ms-scale host jitter
+        # dominates relative drift below this
+        "regression_floor": 5.0,
+    }, {
+        "metric": "inter_token_ms" + suffix,
+        "value": round(float(np.median(step_ms)), 2),
+        "unit": "ms per decode step (all slots)",
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        "regression_floor": 2.0,
+    }]
+
+
 def bench_elastic_resume():
     """Measure the elastic control plane's recovery latency on this
     host: a registered peer goes silent, the master declares it dead
@@ -771,7 +872,9 @@ def main():
             ("checkpoint_roundtrips_per_sec",
              lambda: bench_checkpoint(on_accel)),
             ("cold_start_ms",
-             lambda: bench_deploy(on_accel))]:
+             lambda: bench_deploy(on_accel)),
+            ("decode_tokens_per_sec",
+             lambda: bench_generation(on_accel))]:
         try:
             out = _isolated(fn)
             for line in (out if isinstance(out, list) else [out]):

@@ -119,6 +119,20 @@ compile_cache_dir: None (default) or a directory path. When set, every
   no filesystem access at all — byte-identical legacy behavior.
   Trust boundary: entries deserialize via jax's pickling executable
   format, so point this only at directories you write.
+
+generation_slots / generation_cache_buckets /
+generation_prompt_buckets: defaults for the autoregressive generation
+  session (models.transformer.transformer_lm_session +
+  serving/generation.py). ``generation_slots`` is the decode
+  batch-bucket — how many sequences decode together, each owning one
+  KV-cache slot; ``generation_cache_buckets`` are the cache-length
+  buckets a session pre-allocates (the smallest covering max_len is
+  chosen); ``generation_prompt_buckets`` are the prompt paddings a
+  prefill program is compiled for. Together they close the decode
+  shape set: exactly one compile per (slot-bucket, cache-bucket) plus
+  one per prompt bucket, however many requests flow. Read only at
+  session construction — generation unused costs zero flag checks
+  anywhere.
 """
 
 import jax
@@ -151,6 +165,14 @@ _flags = {
     "elastic_max_restarts": 3,
     # deploy resilience (core/compile_cache.py; None = no disk access)
     "compile_cache_dir": None,
+    # autoregressive generation serving (serving/generation.py +
+    # models.transformer.transformer_lm_session). Read ONLY when a
+    # session/scheduler is constructed — with generation unused,
+    # nothing on the serving fast path or the executor step looks at
+    # these (the off-hot-path guarantee extends to them).
+    "generation_slots": 4,
+    "generation_cache_buckets": (128,),
+    "generation_prompt_buckets": (16,),
 }
 
 # Observers called with the flag dict after every set_flags (the

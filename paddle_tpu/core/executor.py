@@ -344,6 +344,13 @@ class Executor:
         self.place = place
         self.strategy = strategy
         self._cache = {}
+        # Per-instance compile count (incremented only on a cache miss,
+        # never on the steady-state hit path). Unlike the telemetry
+        # counters this is flag-free: it is the proof surface for
+        # closed-shape contracts — serving buckets and generation
+        # (batch-bucket, cache-bucket) steps assert "exactly one
+        # compile per shape across a multi-request run" against it.
+        self._compiles = 0
 
     def _prepare(self, program, feed, fetch_list, scope, donate_state,
                  count_cache=True):
@@ -422,6 +429,7 @@ class Executor:
         telemetry = bool(_config.get_flag("telemetry"))
         entry = self._cache.get(key)
         if entry is None:
+            self._compiles += 1
             if telemetry and count_cache:
                 _CACHE_MISSES.inc()
             built = self._build(program, block, feed_sig, fetch_names,
@@ -472,6 +480,17 @@ class Executor:
             state_ro = {n: self.strategy.shard_state(n, a)
                         for n, a in state_ro.items()}
         return entry, state_rw, state_ro, feed_arrays
+
+    def compile_stats(self):
+        """Flag-free per-executor compile counters: ``entries`` (live
+        compile-cache slots) and ``compiles`` (total trace+compile
+        events this executor ever paid, lower() included). A closed
+        shape set shows here as a plateau: N distinct
+        (program, feed-signature, flags) shapes -> exactly N compiles
+        no matter how many steps run — the generation acceptance
+        criterion (one compile per (batch-bucket, cache-bucket)) and
+        the serving-bucket contract are asserted against this."""
+        return {"entries": len(self._cache), "compiles": self._compiles}
 
     def lower(self, program=None, feed=None, fetch_list=None, scope=None,
               donate_state=True):

@@ -9,8 +9,9 @@ from ..initializer import NormalInitializer
 from . import nn as _nn
 from . import ops as _ops
 
-__all__ = ["multi_head_attention", "transformer_encoder_layer",
-           "positional_encoding"]
+__all__ = ["multi_head_attention", "multi_head_attention_cached",
+           "transformer_encoder_layer", "positional_encoding",
+           "positional_encoding_window"]
 
 
 def multi_head_attention(queries, keys, values, d_model, num_heads,
@@ -47,15 +48,112 @@ def multi_head_attention(queries, keys, values, d_model, num_heads,
                   param_attr=attr("o"), **kwargs)
 
 
+def multi_head_attention_cached(x, cache, d_model, num_heads,
+                                key_length=None, param_attr=None,
+                                name=None, **kwargs):
+    """KV-cached MHA for autoregressive generation — the SAME
+    projections (and parameter names) as :func:`multi_head_attention`,
+    with K/V routed through persistable per-layer cache variables
+    (ops/generation_ops.py) instead of being recomputed from history.
+
+    ``cache``: dict with ``k``/``v`` ([slots, cache_len, d_model]
+    persistable Variables) and ``mode``:
+
+    * ``"prefill"`` — x is one prompt [1, P, D]; the prompt's K/V rows
+      are written into cache slot ``cache["slot"]`` at positions
+      [0, P) and attention runs causally within the prompt window
+      (``key_length`` masks right-padding).
+    * ``"decode"`` — x is one token per slot [S, 1, D]; K/V rows are
+      appended at per-slot positions ``cache["pos"]`` and the single
+      query attends cache rows [0, pos] per slot (its own row
+      included).
+
+    Because the q/k/v/o parameter names match the uncached layer
+    (same ``unique_name`` sequence), programs built under the same
+    ``unique_name.guard()`` discipline share weights through the scope
+    — the cached decode path serves a scope trained by the standard
+    transformer program."""
+    helper = LayerHelper("multi_head_attention", name=name, **kwargs)
+    from ..core import unique_name
+    prefix = name or unique_name.generate("mha")
+
+    def attr(suffix):
+        return param_attr if param_attr is not None else \
+            "%s.%s.w" % (prefix, suffix)
+    q = _nn.fc(x, d_model, num_flatten_dims=2, bias_attr=False,
+               param_attr=attr("qkv_q"), **kwargs)
+    k = _nn.fc(x, d_model, num_flatten_dims=2, bias_attr=False,
+               param_attr=attr("qkv_k"), **kwargs)
+    v = _nn.fc(x, d_model, num_flatten_dims=2, bias_attr=False,
+               param_attr=attr("qkv_v"), **kwargs)
+    ck, cv = cache["k"], cache["v"]
+    ctx_out = helper.create_tmp_variable(x.dtype)
+    if cache["mode"] == "prefill":
+        slot = cache["slot"]
+        # cache writes alias the cache variable name: the executor
+        # marks it written (state_rw) and donates it, so the update is
+        # in place in HBM
+        helper.append_op(type="kv_cache_write_slot",
+                         inputs={"Cache": [ck.name], "New": [k.name],
+                                 "Slot": [slot.name]},
+                         outputs={"Out": [ck.name]})
+        helper.append_op(type="kv_cache_write_slot",
+                         inputs={"Cache": [cv.name], "New": [v.name],
+                                 "Slot": [slot.name]},
+                         outputs={"Out": [cv.name]})
+        inputs = {"Q": [q.name], "K": [k.name], "V": [v.name]}
+        if key_length is not None:
+            inputs["KeyLength"] = [key_length.name]
+        helper.append_op(type="multihead_attention", inputs=inputs,
+                         outputs={"Out": [ctx_out.name]},
+                         attrs={"num_heads": num_heads, "causal": True,
+                                "ring_axis": None})
+    elif cache["mode"] == "decode":
+        pos = cache["pos"]
+        helper.append_op(type="kv_cache_append",
+                         inputs={"Cache": [ck.name], "New": [k.name],
+                                 "Pos": [pos.name]},
+                         outputs={"Out": [ck.name]})
+        helper.append_op(type="kv_cache_append",
+                         inputs={"Cache": [cv.name], "New": [v.name],
+                                 "Pos": [pos.name]},
+                         outputs={"Out": [cv.name]})
+        helper.append_op(type="multihead_attention_decode",
+                         inputs={"Q": [q.name], "CacheK": [ck.name],
+                                 "CacheV": [cv.name],
+                                 "Pos": [pos.name]},
+                         outputs={"Out": [ctx_out.name]},
+                         attrs={"num_heads": num_heads})
+    else:
+        raise ValueError("cache mode must be 'prefill' or 'decode', "
+                         "got %r" % (cache["mode"],))
+    return _nn.fc(ctx_out, d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=attr("o"), **kwargs)
+
+
 def transformer_encoder_layer(x, d_model, num_heads, d_ff, causal=False,
                               key_length=None, ring_axis=None,
                               dropout_prob=0.0, is_test=False, name=None,
-                              **kwargs):
-    """Pre-norm transformer block: x + MHA(LN(x)); x + FFN(LN(x))."""
+                              cache=None, **kwargs):
+    """Pre-norm transformer block: x + MHA(LN(x)); x + FFN(LN(x)).
+    ``cache`` (see :func:`multi_head_attention_cached`) swaps the
+    attention for the KV-cached prefill/decode variant; every
+    parameter name is unchanged."""
     ln1 = _nn.layer_norm(x, begin_norm_axis=2, **kwargs)
-    att = multi_head_attention(ln1, ln1, ln1, d_model, num_heads,
-                               causal=causal, key_length=key_length,
-                               ring_axis=ring_axis, **kwargs)
+    if cache is not None:
+        if ring_axis:
+            raise ValueError(
+                "cache= is incompatible with ring_axis (the cached "
+                "decode path is single-mesh; ring attention shards "
+                "the sequence dim the cache keeps local)")
+        if not causal:
+            raise ValueError("cached attention is causal-only")
+        att = multi_head_attention_cached(ln1, cache, d_model, num_heads,
+                                          key_length=key_length, **kwargs)
+    else:
+        att = multi_head_attention(ln1, ln1, ln1, d_model, num_heads,
+                                   causal=causal, key_length=key_length,
+                                   ring_axis=ring_axis, **kwargs)
     if dropout_prob:
         att = _nn.dropout(att, dropout_prob, is_test=is_test, **kwargs)
     x = _nn.elementwise_add(x, att, **kwargs)
@@ -84,4 +182,50 @@ def positional_encoding(x, max_len=None, name=None, **kwargs):
     helper.append_op(type="elementwise_add",
                      inputs={"X": [x.name], "Y": [pos.name]},
                      outputs={"Out": [out.name]}, attrs={"axis": 1})
+    return out
+
+
+def positional_encoding_window(x, max_len, pos=None, name=None,
+                               **kwargs):
+    """A window of the SAME learned position table as
+    :func:`positional_encoding` (identical parameter name when built
+    under the same ``unique_name`` sequence, so a full-sequence train
+    program and the cached-decode programs share it):
+
+    * ``pos=None`` (prefill): rows [0, x.shape[1]) of the [max_len, D]
+      table are added to x [1, P, D].
+    * ``pos`` given (decode): row ``pos[s]`` is gathered per slot and
+      added to x [S, 1, D] — one position embedding per in-flight
+      sequence, each at its own depth."""
+    helper = LayerHelper("pos_encoding", name=name, **kwargs)
+    d = x.shape[2]
+    table = helper.create_parameter(
+        None, shape=[max_len, d], dtype=x.dtype,
+        default_initializer=NormalInitializer(0.0, 0.02))
+    out = helper.create_tmp_variable(x.dtype)
+    if pos is None:
+        t = x.shape[1]
+        if t > max_len:
+            raise ValueError("prefill window %d exceeds the position "
+                             "table length %d" % (t, max_len))
+        win = helper.create_tmp_variable(x.dtype)
+        helper.append_op(type="slice", inputs={"Input": [table.name]},
+                         outputs={"Out": [win.name]},
+                         attrs={"axes": [0], "starts": [0],
+                                "ends": [t]})
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": [x.name], "Y": [win.name]},
+                         outputs={"Out": [out.name]}, attrs={"axis": 1})
+    else:
+        rows = helper.create_tmp_variable(x.dtype)
+        helper.append_op(type="gather",
+                         inputs={"X": [table.name], "Index": [pos.name]},
+                         outputs={"Out": [rows.name]})
+        rows3 = helper.create_tmp_variable(x.dtype)
+        helper.append_op(type="reshape", inputs={"X": [rows.name]},
+                         outputs={"Out": [rows3.name]},
+                         attrs={"shape": [-1, 1, d]})
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": [x.name], "Y": [rows3.name]},
+                         outputs={"Out": [out.name]}, attrs={})
     return out

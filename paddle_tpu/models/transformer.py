@@ -7,25 +7,49 @@ attention sequence dimension shards across devices (ring attention).
 
 from .. import layers
 from ..layers.attention import (transformer_encoder_layer,
-                                positional_encoding)
+                                positional_encoding,
+                                positional_encoding_window)
 
 __all__ = ["transformer_lm", "transformer_lm_generate",
-           "transformer_tp_rules"]
+           "transformer_lm_session", "transformer_tp_rules"]
 
 
 def _lm_backbone(tokens, vocab_size, d_model, num_heads, d_ff, num_layers,
-                 ring_axis=None, dropout_prob=0.0, is_test=False):
+                 ring_axis=None, dropout_prob=0.0, is_test=False,
+                 cache_ctx=None):
     """tokens [B,T] -> logits [B,T,V]; parameters named via the shared
     embedding/encoder param_attrs so train and generate programs share
-    weights through the scope."""
+    weights through the scope.
+
+    ``cache_ctx`` (KV-cached generation, transformer_lm_session): dict
+    with ``mode`` ('prefill'|'decode'), ``caches`` ([(k, v) Variable
+    pairs per layer]), ``max_len`` (position-table length — must equal
+    the table length of the program whose weights are served), and the
+    mode's index feeds (``slot``/``key_length`` for prefill,
+    ``pos``/``length`` for decode). Every parameter name is identical
+    to the uncached build — cached programs serve a scope trained by
+    the plain ones."""
     emb = layers.embedding(tokens, size=[vocab_size, d_model],
-                           param_attr="tok_embedding")
-    x = positional_encoding(emb)
+                           param_attr="tok_embedding",
+                           keep_dims=cache_ctx is not None)
+    if cache_ctx is None:
+        x = positional_encoding(emb)
+    else:
+        x = positional_encoding_window(emb, cache_ctx["max_len"],
+                                       pos=cache_ctx.get("pos"))
     for i in range(num_layers):
+        cache = None
+        key_length = None
+        if cache_ctx is not None:
+            ck, cv = cache_ctx["caches"][i]
+            cache = {"k": ck, "v": cv, "mode": cache_ctx["mode"],
+                     "slot": cache_ctx.get("slot"),
+                     "pos": cache_ctx.get("pos")}
+            key_length = cache_ctx.get("key_length")
         x = transformer_encoder_layer(
             x, d_model, num_heads, d_ff, causal=True,
-            ring_axis=ring_axis, dropout_prob=dropout_prob,
-            is_test=is_test)
+            key_length=key_length, ring_axis=ring_axis,
+            dropout_prob=dropout_prob, is_test=is_test, cache=cache)
     x = layers.layer_norm(x, begin_norm_axis=2)
     return layers.fc(x, vocab_size, num_flatten_dims=2, bias_attr=False,
                      param_attr="lm_head.w")
@@ -85,11 +109,16 @@ def transformer_lm_generate(batch_anchor, vocab_size, d_model=128,
     BeamSearchDecoder (reference beam_search_op composability demo: the
     same decode engine drives GRU NMT and this transformer).
 
+    **Reference implementation** — the step re-runs the full backbone
+    over the token history, O(L^2) per sequence: the simple exact
+    formulation, kept as the golden oracle for the production path.
+    The KV-cached decode (:func:`transformer_lm_session` +
+    serving.generation) is O(L) and is tested token-for-token identical
+    to this path's greedy (beam_size=1) output
+    (tests/test_generation.py).
+
     ``batch_anchor``: any [B, ...] variable sizing the batch (e.g. an
-    int32 dummy [B, 1]). The step re-runs the full backbone over the
-    token history (O(L^2) — the simple exact formulation; a KV-cache
-    variant is a state-layout change, not an API change).
-    Returns (ids, lengths, scores).
+    int32 dummy [B, 1]). Returns (ids, lengths, scores).
     """
     bs = layers.BeamSearchDecoder(beam_size=beam_size, max_len=max_len,
                                   bos_id=bos_id, eos_id=eos_id)
@@ -106,3 +135,138 @@ def transformer_lm_generate(batch_anchor, vocab_size, d_model=128,
         at_pos = layers.gather(by_time, pos)
         bs.set_logits(layers.reshape(at_pos, [-1, vocab_size]))
     return bs(return_all_beams=return_all_beams)
+
+
+def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
+                           d_ff=256, num_layers=2, max_len=16,
+                           slots=None, cache_len=None,
+                           prompt_buckets=None, bos_id=0, eos_id=1,
+                           cache_ns=None, dtype="float32"):
+    """Build the KV-cached generation programs for the causal LM — the
+    O(L)-per-token production decode path (the O(L^2) reference is
+    :func:`transformer_lm_generate`).
+
+    Two program families, all parameter names identical to
+    :func:`transformer_lm` / the reference generate path (build each
+    under ``unique_name.guard()`` to share a trained scope):
+
+    * **prefill** (one per prompt bucket P): tokens [1, P] + prompt
+      length + slot index -> the prompt's K/V rows written into that
+      slot of every layer's [slots, cache_len, d_model] cache, and the
+      greedy next token at the last prompt position.
+    * **decode** (exactly one per (slots, cache_len) shape): one token
+      per slot + per-slot positions -> K/V appended in place, one
+      single-query attention per layer against the live cache prefix,
+      greedy next token per slot.
+
+    Cache variables are persistable (named under ``cache_ns``, unique
+    per session so several sessions can share one scope/params) and
+    ride the executor's donated state update — the cache never copies.
+    ``max_len`` must equal the position-table length of the program
+    whose weights are served. Defaults for ``slots`` /
+    ``cache_len`` / ``prompt_buckets`` come from the
+    ``generation_slots`` / ``generation_cache_buckets`` /
+    ``generation_prompt_buckets`` config flags (read only here — with
+    no session built, generation costs nothing anywhere).
+
+    Returns a :class:`paddle_tpu.serving.generation.GenerationSpec`
+    consumed by ``GenerationSession`` / ``GenerationScheduler``.
+    """
+    from .. import config as _config
+    from ..core import unique_name as _un
+    from ..core.framework import Program, program_guard
+    from ..serving.generation import GenerationSpec
+
+    if slots is None:
+        slots = int(_config.get_flag("generation_slots"))
+    if slots < 1:
+        raise ValueError("slots must be >= 1, got %r" % (slots,))
+    if cache_len is None:
+        bucks = sorted(int(b) for b in
+                       _config.get_flag("generation_cache_buckets"))
+        cache_len = next((b for b in bucks if b >= max_len),
+                         bucks[-1] if bucks else max_len)
+    cache_len = max(int(cache_len), int(max_len))
+    if prompt_buckets is None:
+        prompt_buckets = _config.get_flag("generation_prompt_buckets")
+    prompt_buckets = tuple(sorted({
+        min(int(p), max_len) for p in prompt_buckets if int(p) >= 1}))
+    if not prompt_buckets:
+        raise ValueError("need at least one prompt bucket")
+    if cache_ns is None:
+        # generated OUTSIDE the guards below, so two sessions over the
+        # same scope never collide on cache names while still sharing
+        # every parameter name
+        cache_ns = _un.generate("kv_session")
+    cache_shape = (slots, cache_len, d_model)
+
+    def make_cache_vars(program):
+        block = program.global_block()
+        caches = []
+        for i in range(num_layers):
+            ck = block.create_var(name="%s.l%d.k" % (cache_ns, i),
+                                  shape=cache_shape, dtype=dtype,
+                                  persistable=True, stop_gradient=True)
+            cv = block.create_var(name="%s.l%d.v" % (cache_ns, i),
+                                  shape=cache_shape, dtype=dtype,
+                                  persistable=True, stop_gradient=True)
+            caches.append((ck, cv))
+        return caches
+
+    prefill_programs = {}
+    prefill_fetch = None
+    for P in prompt_buckets:
+        prog = Program()
+        with _un.guard(), program_guard(prog, Program()):
+            toks = layers.data("gen.ptok", shape=[1, P], dtype="int64",
+                               append_batch_size=False)
+            plen = layers.data("gen.plen", shape=[1], dtype="int32",
+                               append_batch_size=False)
+            ppos = layers.data("gen.ppos", shape=[1], dtype="int32",
+                               append_batch_size=False)
+            slot = layers.data("gen.slot", shape=[1], dtype="int32",
+                               append_batch_size=False)
+            caches = make_cache_vars(prog)
+            logits = _lm_backbone(
+                toks, vocab_size, d_model, num_heads, d_ff, num_layers,
+                is_test=True,
+                cache_ctx={"mode": "prefill", "caches": caches,
+                           "slot": slot, "key_length": plen,
+                           "max_len": max_len})
+            # logits at the last REAL prompt position (ppos = len-1):
+            # [1,P,V] -> [P,1,V] -> [1,1,V] -> [1,V] -> argmax [1]
+            by_time = layers.transpose(logits, [1, 0, 2])
+            at = layers.gather(by_time, ppos)
+            row = layers.reshape(at, [1, vocab_size])
+            nxt = layers.argmax(row, axis=-1)
+        prefill_programs[P] = prog
+        prefill_fetch = nxt.name
+
+    decode_program = Program()
+    with _un.guard(), program_guard(decode_program, Program()):
+        toks = layers.data("gen.dtok", shape=[slots, 1], dtype="int64",
+                           append_batch_size=False)
+        dpos = layers.data("gen.dpos", shape=[slots], dtype="int32",
+                           append_batch_size=False)
+        caches = make_cache_vars(decode_program)
+        logits = _lm_backbone(
+            toks, vocab_size, d_model, num_heads, d_ff, num_layers,
+            is_test=True,
+            cache_ctx={"mode": "decode", "caches": caches, "pos": dpos,
+                       "max_len": max_len})
+        row = layers.reshape(logits, [slots, vocab_size])
+        nxt = layers.argmax(row, axis=-1)
+    decode_fetch = nxt.name
+
+    return GenerationSpec(
+        slots=slots, cache_len=cache_len, max_len=max_len,
+        prompt_buckets=prompt_buckets, bos_id=bos_id, eos_id=eos_id,
+        cache_vars=tuple(("%s.l%d.%s" % (cache_ns, i, kv), cache_shape,
+                          dtype)
+                         for i in range(num_layers) for kv in ("k", "v")),
+        prefill_programs=prefill_programs,
+        prefill_feeds=("gen.ptok", "gen.plen", "gen.ppos", "gen.slot"),
+        prefill_fetch=prefill_fetch,
+        decode_program=decode_program,
+        decode_feeds=("gen.dtok", "gen.dpos"),
+        decode_fetch=decode_fetch)

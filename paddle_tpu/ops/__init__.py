@@ -18,6 +18,7 @@ from . import (  # noqa: F401
     seq2seq_ops,
     control_flow_ops,
     attention_ops,
+    generation_ops,
     crf_ctc_ops,
     beam_search_ops,
     sparse_ops,

@@ -1,0 +1,97 @@
+"""Autoregressive-generation ops: on-device KV cache + single-query
+decode attention.
+
+The reference generated through RecurrentGradientMachine's per-step
+kernel dispatch; the fluid-era answer (and transformer_lm_generate's
+reference path) re-encodes the full token history every step — O(L^2)
+per sequence. These ops are the state-layout change that makes decode
+O(L): per-layer K/V caches live in the Scope as persistable
+[slots, cache_len, d_model] buckets, each step writes one row per
+sequence in place (``dynamic_update_slice`` under executor donation, so
+the update never copies the cache in HBM) and attends a single query
+row against the live prefix.
+
+* ``kv_cache_write_slot`` — prefill: write a whole prompt's K/V rows
+  into ONE slot of the cache (positions [0, T)).
+* ``kv_cache_append``     — decode: write one new K/V row per slot at
+  that slot's own position (per-row ``dynamic_update_slice``).
+* ``multihead_attention_decode`` — one query token per slot against the
+  cache with a per-slot length mask; the Pallas decode kernel
+  (ops/pallas_attention.py ``decode_attention``) when the
+  ``flash_attention`` flag is on, dense XLA otherwise — both share the
+  same masking contract, so flipping the flag never changes tokens.
+
+All shapes here are static (slots and cache_len are compile-time
+bucket sizes): the executor compile cache sees exactly one decode
+entry per (slot-bucket, cache-bucket) pair.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("kv_cache_write_slot")
+def _kv_cache_write_slot(ctx):
+    """Cache [S, C, D], New [1, T, D] (T <= C), Slot [1] int ->
+    Out = Cache with rows [0, T) of slot written. Out aliases the
+    Cache variable name, so the executor's donated state update keeps
+    the write in place."""
+    cache = ctx.input("Cache")
+    new = ctx.input("New")
+    slot = ctx.input("Slot").reshape(-1)[0].astype(jnp.int32)
+    zero = jnp.int32(0)
+    return {"Out": jax.lax.dynamic_update_slice(
+        cache, new.astype(cache.dtype), (slot, zero, zero))}
+
+
+@register_op("kv_cache_append")
+def _kv_cache_append(ctx):
+    """Cache [S, C, D], New [S, 1, D], Pos [S] int -> Out = Cache with
+    row Pos[s] of every slot s overwritten by New[s]. Positions are
+    per-slot (continuous batching: co-resident sequences sit at
+    different depths); out-of-range positions clamp (callers guard)."""
+    cache = ctx.input("Cache")
+    new = ctx.input("New").astype(cache.dtype)
+    pos = ctx.input("Pos").reshape(-1).astype(jnp.int32)
+
+    def upd(c, n, p):
+        return jax.lax.dynamic_update_slice(c, n, (p, jnp.int32(0)))
+
+    return {"Out": jax.vmap(upd)(cache, new, pos)}
+
+
+@register_op("multihead_attention_decode")
+def _multihead_attention_decode(ctx):
+    """Q [S, 1, H*D], CacheK/CacheV [S, C, H*D], Pos [S] int (the row
+    each slot's new token was just written to); attr num_heads.
+    Out [S, 1, H*D]: each slot's single query attends cache rows
+    [0, Pos[s]] — its own token included. Same softmax/masking
+    numerics as the dense multihead_attention row it replaces
+    (token-parity with the O(L^2) reference path is a test
+    invariant)."""
+    q = ctx.input("Q")
+    ck = ctx.input("CacheK")
+    cv = ctx.input("CacheV")
+    length = ctx.input("Pos").reshape(-1).astype(jnp.int32) + 1
+    nh = ctx.attr("num_heads")
+    s, _, dm = q.shape
+    c = ck.shape[1]
+    hd = dm // nh
+    qh = q.reshape(s, nh, hd)
+    kh = ck.reshape(s, c, nh, hd).transpose(0, 2, 1, 3)
+    vh = cv.reshape(s, c, nh, hd).transpose(0, 2, 1, 3)
+
+    from .. import config as _config
+    if _config.get_flag("flash_attention"):
+        from .pallas_attention import decode_attention
+        out = decode_attention(qh, kh, vh, length)
+        return {"Out": out.reshape(s, 1, dm)}
+
+    from .pallas_attention import _decode_reference
+    lens = jnp.broadcast_to(length[:, None], (s, nh)).reshape(s * nh)
+    out = _decode_reference(qh.reshape(s * nh, 1, hd),
+                            kh.reshape(s * nh, c, hd),
+                            vh.reshape(s * nh, c, hd), lens)
+    return {"Out": out.reshape(s, 1, dm)}

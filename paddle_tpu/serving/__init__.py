@@ -24,6 +24,13 @@ Four cooperating pieces:
   (per-output-channel symmetric scales) wired into
   ``io.save_inference_model(..., quantize="int8")`` and transparently
   dequantized at load.
+* :mod:`generation` — the stateful (LLM) tier:
+  :class:`GenerationSession` (on-device KV-cache decode batch,
+  prefill/step/retire over cache slots) and
+  :class:`GenerationScheduler` (continuous batching:
+  ``submit(prompt) -> Future`` with deadlines/backpressure/shedding,
+  mid-flight slot-level admit/retire, per-session breakers, drain,
+  and between-step weight swap).
 
 Everything is instrumented through :mod:`paddle_tpu.observability`;
 ``tools/serving_probe.py`` exercises the stack headless and
@@ -41,8 +48,12 @@ from .resilience import (ServingDeadlineError,  # noqa: F401
 from .deploy import SwapRejectedError  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 from .batcher import MicroBatcher, ServingOverloadError  # noqa: F401
+from .generation import (GenerationScheduler,  # noqa: F401
+                         GenerationSession, GenerationSpec)
 
 __all__ = ["ServingEngine", "MicroBatcher", "ServingOverloadError",
            "ServingDeadlineError", "ServingTimeoutError",
            "ServingUnavailableError", "SwapRejectedError",
-           "ReplicaBreaker", "deploy", "quant", "resilience"]
+           "ReplicaBreaker", "GenerationSession", "GenerationScheduler",
+           "GenerationSpec", "deploy", "generation", "quant",
+           "resilience"]

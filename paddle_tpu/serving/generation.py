@@ -1,0 +1,1017 @@
+"""Autoregressive generation serving: on-device KV-cache sessions and
+a continuous-batching scheduler.
+
+The PR-2/5/7 serving stack is stateless — every request is one padded
+batch through one compiled bucket. An LLM request is a *session*: a
+prompt is prefilled once, then the model is stepped token by token
+against per-sequence state (the KV cache) that must live on device
+between steps. This module adds that stateful tier on top of the same
+machinery:
+
+* :class:`GenerationSession` — owns one decode batch: ``slots``
+  sequences, each with a per-layer [slots, cache_len, d_model] K/V
+  cache resident in a Scope as persistable variables. ``admit()`` runs
+  a prompt-bucket prefill program that fills ONE slot's cache rows and
+  returns the first greedy token; ``step()`` runs the single decode
+  program — one token per slot, per-slot positions — so sequences at
+  different depths decode together. Both programs are compiled exactly
+  once per shape (the executor's compile cache sees a closed set:
+  one decode entry per (slot-bucket, cache-bucket), one prefill entry
+  per prompt bucket — asserted via ``Executor.compile_stats()``), and
+  the caches ride the executor's donated state update: every step is
+  an in-place ``dynamic_update_slice`` in HBM, never a cache copy.
+
+* :class:`GenerationScheduler` — continuous batching:
+  ``submit(prompt) -> Future`` with the MicroBatcher's admission
+  discipline (bounded-queue backpressure -> ServingOverloadError,
+  queue-wait EWMA shedding of hopeless deadlines, per-request
+  deadlines -> ServingDeadlineError), a dispatcher thread that admits
+  new sequences into free cache slots and retires finished ones
+  mid-flight — slot-level, never a whole-batch flush: other sequences
+  keep decoding through every admit/retire — plus the engine tier's
+  recovery vocabulary: a :class:`ReplicaBreaker` per session
+  quarantines a failing session out of admission (trial re-admission
+  after cooldown), ``drain()`` serves everything accepted before
+  stopping (the redeploy story), and ``swap_weights()`` installs new
+  parameter values between decode steps (the deploy-tier hot swap,
+  composed with stateful sessions: the flip lands on a step boundary,
+  so no single forward pass ever mixes weight versions).
+
+Nothing here is constructed by default flags: with no session built,
+the serving fast path, the batcher, and the executor step are
+untouched (the generation_* flags are read only inside constructors).
+
+Metrics (always-on, like the serving front door):
+``paddle_generation_requests_total``, ``_tokens_total``,
+``_prefills_total``, ``_decode_steps_total``,
+``_retired_total{reason}``, ``_slot_occupancy``,
+``_ttft_seconds`` (time to first token), ``_inter_token_seconds``,
+``_request_seconds``. Shed/deadline events share the serving counters
+(``paddle_serving_shed_total`` / ``_deadline_exceeded_total``).
+Fault site: ``generation_step_fail`` (indexed by session).
+"""
+
+import collections
+import itertools
+import queue
+import threading
+import time
+import weakref
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
+
+import numpy as np
+
+from .. import config as _config
+from ..core.executor import Executor
+from ..core.scope import global_scope
+from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
+from ..resilience import faults as _faults
+from ..utils import log as _log
+from . import resilience as _sres
+from .batcher import ServingOverloadError, _resolve, _WAIT_ALPHA
+from .resilience import (ReplicaBreaker, ServingDeadlineError,
+                         ServingUnavailableError)
+
+__all__ = ["GenerationSpec", "GenerationSession", "GenerationScheduler"]
+
+_REQUESTS = _metrics.REGISTRY.counter(
+    "paddle_generation_requests_total",
+    "Generation requests admitted into a cache slot")
+_TOKENS = _metrics.REGISTRY.counter(
+    "paddle_generation_tokens_total",
+    "Tokens decoded across all sequences (prefill's first token "
+    "included)")
+_PREFILLS = _metrics.REGISTRY.counter(
+    "paddle_generation_prefills_total",
+    "Prompt prefills executed, per prompt bucket",
+    labelnames=("bucket",))
+_STEPS = _metrics.REGISTRY.counter(
+    "paddle_generation_decode_steps_total",
+    "Decode steps executed (one per session step, all slots at once)")
+_RETIRED = _metrics.REGISTRY.counter(
+    "paddle_generation_retired_total",
+    "Sequences retired from their slot", labelnames=("reason",))
+_OCCUPANCY = _metrics.REGISTRY.gauge(
+    "paddle_generation_slot_occupancy",
+    "Active sequences / total cache slots across one scheduler's "
+    "sessions (labelled per scheduler — two engines side by side "
+    "must not overwrite each other)", labelnames=("scheduler",))
+_TTFT_SECONDS = _metrics.REGISTRY.histogram(
+    "paddle_generation_ttft_seconds",
+    "Submit -> first token latency (queue wait + prefill)")
+_INTER_TOKEN_SECONDS = _metrics.REGISTRY.histogram(
+    "paddle_generation_inter_token_seconds",
+    "Per-sequence latency between consecutive tokens")
+_REQUEST_SECONDS = _metrics.REGISTRY.histogram(
+    "paddle_generation_request_seconds",
+    "Submit -> Future resolution for completed generations")
+
+_STOP = object()
+
+# distinguishes per-session breaker gauge labels across schedulers
+_SCHED_SEQ = itertools.count()
+
+# scope -> set of cache-variable names already driven by a live
+# session. Two sessions sharing cache names on one scope would
+# silently corrupt each other's KV state (slot s of one overwrites
+# rows the other's slot s attends), so construction refuses the
+# collision — transformer_lm_session generates a fresh cache_ns per
+# call, making a second spec the correct way to add a replica.
+_CACHE_CLAIMS = weakref.WeakKeyDictionary()
+
+
+class GenerationSpec:
+    """The contract between a model's session builder (e.g.
+    ``models.transformer.transformer_lm_session``) and the generic
+    session/scheduler: programs plus the feed/fetch naming.
+
+    * ``prefill_programs``: {prompt_bucket P: Program} — tokens
+      [1, P] -> first greedy token [1], writing cache slot rows [0, P).
+      ``prefill_feeds`` names (tokens, prompt_len, last_pos, slot).
+    * ``decode_program``: one step for ALL slots — tokens [slots, 1] +
+      positions [slots] -> next token per slot. ``decode_feeds`` names
+      (tokens, positions).
+    * ``cache_vars``: ((name, shape, dtype), ...) persistable cache
+      variables a session materializes as device zeros in its scope.
+    """
+
+    __slots__ = ("slots", "cache_len", "max_len", "prompt_buckets",
+                 "bos_id", "eos_id", "cache_vars", "prefill_programs",
+                 "prefill_feeds", "prefill_fetch", "decode_program",
+                 "decode_feeds", "decode_fetch")
+
+    def __init__(self, **kwargs):
+        for name in self.__slots__:
+            setattr(self, name, kwargs.pop(name))
+        if kwargs:
+            raise TypeError("unknown GenerationSpec fields: %s"
+                            % sorted(kwargs))
+
+
+class GenerationSession:
+    """One decode batch: ``spec.slots`` cache slots over one scope.
+
+    Parameters are read from ``scope`` by name (run/load them first —
+    a scope trained by the standard program, or a checkpoint/artifact
+    restore); cache variables are created here as device zeros. All
+    methods are single-threaded by contract: the scheduler's
+    dispatcher thread is the only caller in the serving deployment.
+
+    The executor compile cache stays CLOSED over a session's lifetime:
+    every ``step()`` has the same (program, feed-signature) key, every
+    ``admit()`` one key per prompt bucket — ``compile_stats()`` is the
+    proof, asserted in tests and printed by tools/generate_probe.py.
+    """
+
+    def __init__(self, spec, scope=None, place=None):
+        import jax.numpy as jnp
+        self.spec = spec
+        self.scope = scope if scope is not None else global_scope()
+        self.exe = Executor(place=place)
+        names = {name for name, _, _ in spec.cache_vars}
+        claimed = _CACHE_CLAIMS.setdefault(self.scope, set())
+        overlap = sorted(claimed & names)
+        if overlap:
+            raise ValueError(
+                "cache variables %s on this scope are already driven "
+                "by another GenerationSession — build a fresh spec "
+                "(transformer_lm_session generates a unique cache_ns "
+                "per call), or close() the old session" % overlap)
+        claimed |= names
+        self._claimed = names
+        for name, shape, dtype in spec.cache_vars:
+            if not self.scope.has_var(name):
+                self.scope.set_var(name, jnp.zeros(shape, dtype))
+        n = spec.slots
+        self.lengths = np.zeros(n, np.int64)     # cached rows per slot
+        self.last_token = np.zeros(n, np.int64)  # next token to decode
+        self.active = np.zeros(n, bool)
+        # the deepest position any sequence may WRITE: bounded by the
+        # cache bucket and by the learned position table
+        self.max_pos = min(spec.cache_len, spec.max_len)
+
+    # -- slot bookkeeping ------------------------------------------------
+    def free_slots(self):
+        return [int(i) for i in np.flatnonzero(~self.active)]
+
+    def active_slots(self):
+        return [int(i) for i in np.flatnonzero(self.active)]
+
+    def occupancy(self):
+        return float(self.active.sum()) / self.spec.slots
+
+    def capacity_left(self, slot):
+        """Decode steps slot can still take before its cache bucket or
+        position table runs out."""
+        return int(self.max_pos - self.lengths[slot])
+
+    def prompt_bucket(self, n):
+        for p in self.spec.prompt_buckets:
+            if n <= p:
+                return p
+        return None
+
+    def compile_stats(self):
+        return self.exe.compile_stats()
+
+    def close(self):
+        """Release this session's cache-variable claim (and drop the
+        cache arrays from the scope), so a later session may reuse the
+        names. Idempotent; the session must not be stepped after."""
+        claimed = _CACHE_CLAIMS.get(self.scope)
+        if claimed is not None:
+            claimed -= self._claimed
+        for name in self._claimed:
+            self.scope.erase(name)
+        self._claimed = set()
+        self.active[:] = False
+
+    # -- execution -------------------------------------------------------
+    def admit(self, prompt):
+        """Prefill ``prompt`` (1-D int ids) into a free slot: the
+        prompt's K/V rows land in the cache, the slot becomes active,
+        and the first greedy token is returned as ``(slot, token)``.
+        Raises RuntimeError when no slot is free and ValueError when
+        the prompt fits no bucket."""
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        n = prompt.size
+        if n < 1:
+            raise ValueError("empty prompt")
+        bucket = self.prompt_bucket(n)
+        if bucket is None:
+            raise ValueError(
+                "prompt length %d exceeds the largest prompt bucket %d"
+                % (n, self.spec.prompt_buckets[-1]))
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free cache slot (%d active)"
+                               % self.spec.slots)
+        slot = free[0]
+        padded = np.full((1, bucket), self.spec.eos_id, np.int64)
+        padded[0, :n] = prompt
+        f_tok, f_len, f_pos, f_slot = self.spec.prefill_feeds
+        with _tracing.span("generationPrefill", bucket=bucket):
+            outs = self.exe.run(
+                self.spec.prefill_programs[bucket],
+                feed={f_tok: padded,
+                      f_len: np.asarray([n], np.int32),
+                      f_pos: np.asarray([n - 1], np.int32),
+                      f_slot: np.asarray([slot], np.int32)},
+                fetch_list=[self.spec.prefill_fetch], scope=self.scope)
+        first = int(np.asarray(outs[0]).reshape(-1)[0])
+        self.lengths[slot] = n
+        self.last_token[slot] = first
+        self.active[slot] = True
+        _PREFILLS.labels(bucket=bucket).inc()
+        return slot, first
+
+    def step(self):
+        """One decode step for EVERY active slot: each slot's pending
+        token is embedded at its own position, its K/V row appended in
+        place, and its single query attended against the live cache
+        prefix. Returns {slot: next_token} for active slots (free
+        slots compute masked garbage that the next prefill
+        overwrites). Raises RuntimeError when an active slot is out of
+        cache capacity — retire it first."""
+        act = np.flatnonzero(self.active)
+        if act.size == 0:
+            return {}
+        if (self.lengths[act] >= self.max_pos).any():
+            over = [int(s) for s in act
+                    if self.lengths[s] >= self.max_pos]
+            raise RuntimeError(
+                "slots %s are at cache capacity %d — retire before "
+                "stepping" % (over, self.max_pos))
+        f_tok, f_pos = self.spec.decode_feeds
+        with _tracing.span("generationStep",
+                           active=int(act.size)):
+            outs = self.exe.run(
+                self.spec.decode_program,
+                feed={f_tok: self.last_token.reshape(-1, 1),
+                      f_pos: self.lengths.astype(np.int32)},
+                fetch_list=[self.spec.decode_fetch], scope=self.scope)
+        nxt = np.asarray(outs[0]).reshape(-1)
+        result = {}
+        for s in act:
+            s = int(s)
+            self.lengths[s] += 1
+            self.last_token[s] = int(nxt[s])
+            result[s] = int(nxt[s])
+        return result
+
+    def retire(self, slot):
+        """Free a slot mid-flight. The cache rows are left as-is — the
+        next prefill into this slot overwrites them, and the per-slot
+        length mask keeps them unattendable meanwhile."""
+        self.active[slot] = False
+        self.lengths[slot] = 0
+        self.last_token[slot] = 0
+
+    def generate(self, prompt, max_new_tokens=None, eos_id=None):
+        """Synchronous single-sequence convenience (tests/probes): the
+        greedy continuation of ``prompt``, stopping at ``eos_id`` or
+        ``max_new_tokens``, as a list of ids (EOS excluded)."""
+        eos = self.spec.eos_id if eos_id is None else eos_id
+        slot, first = self.admit(prompt)
+        # prefill already produced one token; each further step can
+        # write one more K/V row, so cap+1 tokens total fit the slot
+        cap = self.capacity_left(slot)
+        limit = cap + 1 if max_new_tokens is None \
+            else min(int(max_new_tokens), cap + 1)
+        tokens = [first]
+        try:
+            while tokens[-1] != eos and len(tokens) < limit:
+                tokens.append(self.step()[slot])
+        finally:
+            self.retire(slot)
+        if tokens and tokens[-1] == eos:
+            tokens = tokens[:-1]
+        return tokens
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new", "explicit_budget", "eos_id",
+                 "future", "deadline", "t_submit", "tokens", "slot",
+                 "session_index", "t_last")
+
+    def __init__(self, prompt, max_new, explicit_budget, eos_id,
+                 deadline):
+        self.prompt = prompt
+        self.max_new = max_new
+        # True when the CALLER asked for max_new tokens (placement
+        # must find a session able to serve them all); False when the
+        # budget is the implicit "as much as fits" cap, which any
+        # fitting session satisfies by definition
+        self.explicit_budget = explicit_budget
+        self.eos_id = eos_id  # None until placement picks a session
+        self.future = Future()
+        self.deadline = deadline  # absolute time.monotonic() or None
+        self.t_submit = time.perf_counter()
+        self.tokens = []
+        self.slot = None
+        self.session_index = None
+        self.t_last = None
+
+
+class GenerationScheduler:
+    """Continuous-batching front door over one or more
+    :class:`GenerationSession` replicas.
+
+    ``submit(prompt) -> Future`` resolves to the generated ids as an
+    int64 array (greedy continuation, EOS excluded). The dispatcher
+    thread interleaves two moves forever: admit queued requests into
+    free cache slots (prefill), and run one decode step for every
+    session with active slots. Sequences finish (EOS / token budget /
+    deadline) and retire slot-by-slot — co-resident sequences never
+    stall or flush for an admit or retire.
+
+    Admission reuses the MicroBatcher discipline: bounded queue
+    (``submit`` blocks, or raises :class:`ServingOverloadError` with a
+    ``timeout``), queue-wait EWMA shedding when a deadline budget is
+    already hopeless, expired deadlines resolved with
+    :class:`ServingDeadlineError` before touching a device; a deadline
+    that expires MID-generation retires the slot and resolves the
+    Future with ServingDeadlineError (stateful requests hold a slot —
+    letting them linger past their budget starves admission).
+
+    With ``breaker_failures`` (default: the
+    ``serving_breaker_failures`` flag; 0 = off) each session gets a
+    :class:`ReplicaBreaker`: a failing session's active requests fail
+    over is impossible (their cache state died with the session), so
+    they resolve exceptionally, the session is quarantined out of
+    admission, and a cooldown-gated trial prefill re-admits it.
+
+    ``drain()`` stops admission and serves everything accepted;
+    ``close()`` is the bounded fast exit. ``swap_weights(params)``
+    installs new values between decode steps (see method docs).
+    """
+
+    def __init__(self, sessions, max_queue=256, deadline_ms=None,
+                 breaker_failures=None, breaker_cooldown_ms=None,
+                 autostart=True):
+        if isinstance(sessions, GenerationSession):
+            sessions = [sessions]
+        if not sessions:
+            raise ValueError("need at least one GenerationSession")
+        self.sessions = list(sessions)
+        self._q = queue.Queue(maxsize=max_queue)
+        # dispatcher-local order-preserving buffer: items parked when
+        # no slot is free right now, and re-queue overflow from the
+        # deadline sweep (consumed before the queue)
+        self._pending = collections.deque()
+        # True while some waiting item MAY carry a deadline — gates
+        # the per-tick expiry sweep, which would otherwise rotate the
+        # whole bounded queue on every decode step for nothing
+        self._has_deadlines = False
+        self._closed = False
+        self._thread = None
+        self._wait_ewma = 0.0
+        self._active = {}   # (session_index, slot) -> _GenRequest
+        self._sched_id = next(_SCHED_SEQ)
+        if deadline_ms is None:
+            deadline_ms = _config.get_flag("serving_deadline_ms")
+        self.default_deadline_ms = deadline_ms
+        if breaker_failures is None:
+            breaker_failures = _config.get_flag(
+                "serving_breaker_failures")
+        if breaker_cooldown_ms is None:
+            breaker_cooldown_ms = _config.get_flag(
+                "serving_breaker_cooldown_ms")
+        if breaker_failures:
+            self._breakers = [
+                ReplicaBreaker(i, breaker_failures,
+                               float(breaker_cooldown_ms) / 1e3,
+                               label="gen%d:%d" % (self._sched_id, i))
+                for i in range(len(self.sessions))]
+        else:
+            self._breakers = None
+        self._swap_lock = threading.Lock()
+        self._pending_swap = None  # (params, Future)
+        self._weights_version = 0
+        if autostart:
+            self.start()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        if self._closed:
+            raise RuntimeError("scheduler is closed")
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="generation-scheduler",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    @property
+    def weights_version(self):
+        return self._weights_version
+
+    def session_health(self):
+        if self._breakers is None:
+            return ["closed"] * len(self.sessions)
+        return [b.state for b in self._breakers]
+
+    # -- admission -------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, eos_id=None,
+               deadline_ms=None, timeout=None):
+        """Enqueue one prompt; returns a Future of its generated ids.
+
+        ``max_new_tokens`` is capped by the slot capacity left after
+        the prompt (cache bucket / position table). ``deadline_ms``
+        (default: the scheduler's ``deadline_ms``, itself defaulting
+        to the ``serving_deadline_ms`` flag; 0/None = none) bounds the
+        WHOLE generation. ``timeout``: seconds to wait on a full
+        queue before :class:`ServingOverloadError`."""
+        if self._closed:
+            raise RuntimeError("scheduler is closed")
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        # the prompt must fit SOME session's buckets (placement later
+        # routes it only to sessions that can take it); the decode
+        # budget cap comes from the most permissive fitting session
+        fitting = [s for s in self.sessions
+                   if s.prompt_bucket(prompt.size) is not None]
+        if not fitting:
+            raise ValueError(
+                "prompt length %d exceeds every session's largest "
+                "prompt bucket (max %d)"
+                % (prompt.size,
+                   max(s.spec.prompt_buckets[-1]
+                       for s in self.sessions)))
+        cap = max(s.max_pos for s in fitting) - prompt.size + 1
+        if cap < 1:
+            raise ValueError(
+                "prompt length %d leaves no decode capacity in any "
+                "session's cache bucket" % prompt.size)
+        explicit = max_new_tokens is not None
+        max_new = cap if not explicit else min(int(max_new_tokens), cap)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        deadline = None
+        if deadline_ms:  # 0/None = no deadline, the PR-5 contract
+            budget = float(deadline_ms) / 1e3
+            if budget < 0:
+                _sres.DEADLINE_EXCEEDED.inc()
+                raise ServingDeadlineError(
+                    "deadline budget %.1f ms already spent"
+                    % float(deadline_ms))
+            projected = self._wait_ewma * (1.0 + self._q.qsize())
+            if projected > budget:
+                # same geometric decay as the batcher: sheds must not
+                # latch the estimate high on an idle queue
+                self._wait_ewma *= (1.0 - _WAIT_ALPHA)
+                _sres.SHED.inc()
+                raise ServingOverloadError(
+                    "shed: projected admission wait %.1f ms exceeds "
+                    "the %.1f ms deadline budget"
+                    % (projected * 1e3, budget * 1e3))
+            deadline = time.monotonic() + budget
+        item = _GenRequest(prompt, max_new, explicit, eos_id, deadline)
+        try:
+            self._q.put(item, block=True, timeout=timeout)
+        except queue.Full:
+            _sres.SHED.inc()
+            raise ServingOverloadError(
+                "generation queue full (%d pending)"
+                % self._q.qsize()) from None
+        if deadline is not None:
+            # AFTER the put: the sweep recomputes the flag from queue
+            # content, so this order can never strand a deadline item
+            # behind a cleared flag
+            self._has_deadlines = True
+        if self._closed and self._thread is None:
+            # raced a close()/drain() past its leftover sweep (the
+            # batcher's shutdown race, same resolution: fail OUR
+            # future idempotently and refuse the submit)
+            _resolve(item.future,
+                     exception=RuntimeError("scheduler closed"))
+            raise RuntimeError("scheduler is closed")
+        return item.future
+
+    # -- dispatcher ------------------------------------------------------
+    def _next_item(self, block):
+        """Next request to place: the parked buffer first (preserves
+        order), then the queue. None when nothing is waiting."""
+        if self._pending:
+            return self._pending.popleft()
+        try:
+            if block:
+                return self._q.get(timeout=0.05)
+            return self._q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _fits(self, sess, item):
+        """Can ``sess`` serve this request IN FULL — prompt bucket and
+        enough cache capacity for the promised token budget? Placement
+        on a smaller-cache session would silently retire the sequence
+        early with reason 'capacity', under-delivering the budget
+        submit() accepted. An implicit ("as much as fits") budget is
+        satisfied by ANY fitting session — requiring the largest
+        session's cap would strand idle smaller replicas."""
+        n = item.prompt.size
+        need = item.max_new if item.explicit_budget else 1
+        return sess.prompt_bucket(n) is not None and \
+            sess.max_pos - n + 1 >= need
+
+    def _eligible_session(self, item, claim=False):
+        """Index of a session that can take this request NOW
+        (free slot + fitting bucket/capacity + breaker closed, or a
+        cooldown-elapsed trial when nothing fitting is closed), or
+        None. The half_open transition — a trial admission is the
+        probe — fires only with ``claim=True``, i.e. when an actual
+        request is about to be admitted; a capacity poll must not
+        burn a breaker's cooldown with no trial to run."""
+        candidates = [i for i, s in enumerate(self.sessions)
+                      if s.free_slots() and self._fits(s, item)]
+        if not candidates:
+            return None
+        if self._breakers is None:
+            return candidates[0]
+        closed = [i for i in candidates
+                  if self._breakers[i].state == "closed"]
+        if closed:
+            return closed[0]
+        now = time.monotonic()
+        for i in candidates:
+            breaker = self._breakers[i]
+            if breaker.state == "half_open" or \
+                    breaker.ready_to_probe(now):
+                if claim:
+                    breaker.to_half_open()
+                return i
+        return None
+
+    def _dispatchable_later(self, item):
+        """True when some session fitting this request is healthy
+        (or trial-ready) but merely out of free slots — a retiring
+        sequence will make room, so the request should wait."""
+        for i, s in enumerate(self.sessions):
+            if not self._fits(s, item):
+                continue
+            breaker = self._breakers[i] if self._breakers else None
+            if breaker is None or \
+                    breaker.state in ("closed", "half_open") or \
+                    breaker.ready_to_probe():
+                return True
+        return False
+
+    def _expire(self, item, where):
+        _sres.DEADLINE_EXCEEDED.inc()
+        _resolve(item.future, exception=ServingDeadlineError(
+            "deadline expired after %.1f ms %s"
+            % ((time.perf_counter() - item.t_submit) * 1e3, where)))
+
+    def _expire_queued(self):
+        """Resolve expired deadlines for requests still waiting — even
+        while every slot is busy. The batcher drops expired items at
+        every dispatch tick; a slot-starved stretch must not suspend
+        that contract and leave a doomed caller blocked until some
+        unrelated sequence retires. Gated by ``_has_deadlines`` so a
+        deadline-free workload never pays the queue rotation."""
+        if not self._has_deadlines:
+            return
+        now = time.monotonic()
+        remaining = False
+        keep = collections.deque()
+        while self._pending:
+            item = self._pending.popleft()
+            if item is not _STOP and item.deadline is not None \
+                    and now >= item.deadline:
+                self._expire(item, "in queue")
+            else:
+                if item is not _STOP and item.deadline is not None:
+                    remaining = True
+                keep.append(item)
+        self._pending = keep
+        for _ in range(self._q.qsize()):
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP and item.deadline is not None \
+                    and now >= item.deadline:
+                self._expire(item, "in queue")
+            else:
+                if item is not _STOP and item.deadline is not None:
+                    remaining = True
+                try:
+                    self._q.put_nowait(item)
+                except queue.Full:
+                    # a racing submit took the freed capacity: the
+                    # parked buffer keeps the item dispatchable
+                    self._pending.append(item)
+        # recomputed from content — a submit landing mid-sweep re-arms
+        # the flag itself after its put
+        self._has_deadlines = remaining
+
+    def _place(self, item):
+        """Admit ``item`` somewhere, park it for later, or resolve it.
+        Returns False when the item was parked (no capacity right now
+        — the caller should stop pulling from the queue)."""
+        if item.deadline is not None and \
+                time.monotonic() >= item.deadline:
+            self._expire(item, "in queue")
+            return True
+        si = self._eligible_session(item, claim=True)
+        if si is None:
+            if self._dispatchable_later(item):
+                self._pending.appendleft(item)
+                return False
+            # every fitting session is quarantined with its cooldown
+            # still running: fail explicitly rather than wedging the
+            # request in a queue nothing drains (stateful requests
+            # can't fail over mid-flight, so honesty beats hope)
+            _resolve(item.future, exception=ServingUnavailableError(
+                "no healthy generation session for this prompt"))
+            return True
+        self._admit_item(item, si)
+        return True
+
+    def _admit_item(self, item, si):
+        wait = time.perf_counter() - item.t_submit
+        self._wait_ewma += _WAIT_ALPHA * (wait - self._wait_ewma)
+        sess = self.sessions[si]
+        breaker = self._breakers[si] if self._breakers else None
+        try:
+            slot, first = sess.admit(item.prompt)
+        except ValueError as exc:
+            # a client-shaped prompt (bucket/length) is the request's
+            # fault, not the session's — it must not charge the
+            # breaker and quarantine a healthy session
+            _resolve(item.future, exception=exc)
+            return
+        except Exception as exc:
+            if breaker is not None:
+                breaker.record_failure()
+            _resolve(item.future, exception=exc)
+            return
+        if breaker is not None:
+            breaker.record_success()
+        if item.eos_id is None:
+            item.eos_id = sess.spec.eos_id
+        _REQUESTS.inc()
+        _TOKENS.inc()
+        now_pc = time.perf_counter()
+        _TTFT_SECONDS.observe(now_pc - item.t_submit)
+        item.t_last = now_pc
+        item.slot = slot
+        item.session_index = si
+        item.tokens.append(first)
+        self._active[(si, slot)] = item
+        self._update_occupancy()
+        self._finish_if_done(item)  # EOS/budget can end it at token 1
+
+    def _finish_if_done(self, item):
+        """Retire/resolve when EOS, budget, capacity, or deadline ends
+        the sequence. Returns True when the request left its slot."""
+        sess = self.sessions[item.session_index]
+        reason = None
+        if item.tokens and item.tokens[-1] == item.eos_id:
+            item.tokens.pop()
+            reason = "eos"
+        elif len(item.tokens) >= item.max_new:
+            reason = "max_tokens"
+        elif sess.capacity_left(item.slot) <= 0:
+            reason = "capacity"
+        elif item.deadline is not None and \
+                time.monotonic() >= item.deadline:
+            reason = "deadline"
+        if reason is None:
+            return False
+        sess.retire(item.slot)
+        del self._active[(item.session_index, item.slot)]
+        _RETIRED.labels(reason=reason).inc()
+        if reason == "deadline":
+            _sres.DEADLINE_EXCEEDED.inc()
+            _resolve(item.future, exception=ServingDeadlineError(
+                "deadline expired mid-generation after %d tokens"
+                % len(item.tokens)))
+        else:
+            _REQUEST_SECONDS.observe(time.perf_counter()
+                                     - item.t_submit)
+            _resolve(item.future,
+                     result=np.asarray(item.tokens, np.int64))
+        self._update_occupancy()
+        return True
+
+    def _step_all(self):
+        for si, sess in enumerate(self.sessions):
+            mine = [(slot, it) for (s_i, slot), it
+                    in list(self._active.items()) if s_i == si]
+            if not mine:
+                continue
+            breaker = self._breakers[si] if self._breakers else None
+            try:
+                _faults.fire_point("generation_step_fail", index=si)
+                toks = sess.step()
+            except Exception as exc:
+                # a session's cache state is unrecoverable mid-flight:
+                # its requests resolve exceptionally and the breaker
+                # (when armed) quarantines the session out of
+                # admission until a trial prefill succeeds
+                if breaker is not None:
+                    breaker.record_failure()
+                _log.structured("generation_step_failed", session=si,
+                                error=repr(exc),
+                                requests=len(mine))
+                for slot, it in mine:
+                    sess.retire(slot)
+                    self._active.pop((si, slot), None)
+                    _RETIRED.labels(reason="error").inc()
+                    _resolve(it.future, exception=exc)
+                self._update_occupancy()
+                continue
+            if breaker is not None:
+                breaker.record_success()
+            _STEPS.inc()
+            _TOKENS.inc(len(mine))
+            now_pc = time.perf_counter()
+            for slot, it in mine:
+                it.tokens.append(toks[slot])
+                _INTER_TOKEN_SECONDS.observe(now_pc - it.t_last)
+                it.t_last = now_pc
+                self._finish_if_done(it)
+
+    def _update_occupancy(self):
+        total = sum(s.spec.slots for s in self.sessions)
+        _OCCUPANCY.labels(scheduler="gen%d" % self._sched_id).set(
+            len(self._active) / float(total))
+
+    def _apply_pending_swap(self):
+        with self._swap_lock:
+            pending, self._pending_swap = self._pending_swap, None
+        if pending is None:
+            return
+        params, future = pending
+        try:
+            scopes = []
+            for sess in self.sessions:
+                if sess.scope not in scopes:
+                    scopes.append(sess.scope)
+            cache_names = {name for s in self.sessions
+                           for name, _, _ in s.spec.cache_vars}
+            # phase 1: validate EVERY scope before mutating ANY — a
+            # rejection on the second scope must not leave the first
+            # already serving the rejected weights (torn swap)
+            for scope in scopes:
+                for name, val in params.items():
+                    if name in cache_names:
+                        raise ValueError(
+                            "refusing to overwrite cache variable %r"
+                            % name)
+                    cur = scope.find_var(name)
+                    if cur is None:
+                        raise ValueError(
+                            "swap names unknown variable %r" % name)
+                    val = np.asarray(val)
+                    # metadata-only checks: materializing live device
+                    # params on host here would stall the decode loop
+                    # for a full model D2H copy per swap
+                    cur_shape = tuple(np.shape(cur))
+                    cur_dtype = np.dtype(cur.dtype) \
+                        if hasattr(cur, "dtype") \
+                        else np.asarray(cur).dtype
+                    if tuple(val.shape) != cur_shape or \
+                            val.dtype != cur_dtype:
+                        raise ValueError(
+                            "signature mismatch on %r: push %s/%s vs "
+                            "live %s/%s"
+                            % (name, val.shape, val.dtype,
+                               cur_shape, cur_dtype))
+            # phase 2: install everywhere (pure pointer installs —
+            # nothing here can raise and tear the fleet)
+            for scope in scopes:
+                for name, val in params.items():
+                    scope.set_var(name, np.asarray(val))
+            self._weights_version += 1
+            _log.structured("generation_weights_swapped",
+                            version=self._weights_version,
+                            params=len(params))
+            _resolve(future, result=self._weights_version)
+        except Exception as exc:
+            _resolve(future, exception=exc)
+
+    def swap_weights(self, params, timeout=30.0):
+        """Install new parameter values (``{name: array}``) on every
+        session's scope BETWEEN decode steps — the hot-swap story for
+        stateful serving. The flip lands on a step boundary (the
+        dispatcher applies it before its next admit/step), so no
+        forward pass mixes versions; sequences already mid-generation
+        continue on the new weights, which is the documented semantic
+        for session state (their KV cache keeps the old weights'
+        values — retire-and-retry callers who need strict isolation).
+        Cache variables are refused; name/shape/dtype mismatches
+        reject the push. Returns the new weights version."""
+        if self._closed:
+            raise RuntimeError("scheduler is closed")
+        future = Future()
+        with self._swap_lock:
+            if self._pending_swap is not None:
+                raise RuntimeError("a weight swap is already pending")
+            self._pending_swap = (dict(params), future)
+        if self._thread is None:
+            self._apply_pending_swap()
+        try:
+            return future.result(timeout=timeout)
+        except FutureTimeoutError:
+            with self._swap_lock:
+                if self._pending_swap is not None and \
+                        self._pending_swap[1] is future:
+                    # still queued: cancel it so the "failed" push can
+                    # never land silently later, and a retry isn't
+                    # blocked by a phantom pending swap
+                    self._pending_swap = None
+                    raise RuntimeError(
+                        "weight swap not applied within %.0fs — "
+                        "cancelled" % timeout) from None
+            # the dispatcher picked it up mid-wait: the install is a
+            # bounded pointer flip, give it a moment to land
+            return future.result(timeout=5.0)
+
+    def _serve_out(self):
+        """Post-stop epilogue, on the dispatcher thread: finish every
+        active slot AND place-and-serve everything still waiting —
+        including submits that raced the stop marker into the queue
+        (a timed-out close()/drain() join leaves this thread sole
+        owner of the queues, so an unserved straggler here would be a
+        Future nothing ever resolves). Waiting items co-batch into
+        free slots like live traffic."""
+        while True:
+            self._apply_pending_swap()
+            if self._active:
+                self._step_all()
+                continue
+            item = self._next_item(block=False)
+            if item is None:
+                return
+            if item is _STOP:
+                continue
+            if not self._place(item) and not self._active:
+                # unplaceable with nothing in flight (external slot
+                # holders): resolve rather than spinning forever
+                parked = self._pending.popleft()
+                _resolve(parked.future,
+                         exception=ServingUnavailableError(
+                             "scheduler stopped before the request "
+                             "could be placed"))
+
+    def _loop(self):
+        while True:
+            self._apply_pending_swap()
+            if self._active:
+                self._expire_queued()
+                got_stop = self._fill_slots()
+                self._step_all()
+                if got_stop:
+                    self._serve_out()
+                    return
+            else:
+                item = self._next_item(block=True)
+                if item is None:
+                    if self._closed:
+                        return
+                    continue
+                if item is _STOP:
+                    self._serve_out()  # stragglers behind the marker
+                    return
+                if not self._place(item):
+                    # parked with nothing active: only possible while
+                    # every fitting session's slots are held outside
+                    # this scheduler — back off instead of spinning
+                    time.sleep(0.02)
+
+    def _fill_slots(self):
+        """Admit waiting requests into free slots without blocking.
+        Returns True when the stop marker was consumed."""
+        while True:
+            item = self._next_item(block=False)
+            if item is None:
+                return False
+            if item is _STOP:
+                return True
+            if not self._place(item):
+                return False  # head parked: no capacity this tick
+
+    # -- shutdown --------------------------------------------------------
+    def _stop_dispatcher(self, timeout):
+        self._closed = True
+        if self._thread is not None:
+            try:
+                self._q.put_nowait(_STOP)
+            except queue.Full:
+                pass
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                # the dispatcher is still finishing in-flight
+                # generations past the bounded wait: it OWNS the
+                # queues (sweeping them from under a live thread
+                # races its every tick) and will serve what it holds
+                # and exit on closed. Leave everything to it.
+                return []
+            self._thread = None
+        leftovers = [item for item in self._pending if item is not _STOP]
+        self._pending.clear()
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                leftovers.append(item)
+        return leftovers
+
+    def drain(self, timeout=None):
+        """Graceful drain: stop admission, generate every accepted
+        request to completion — in-flight slots AND waiting submits
+        (parked or racing the stop marker; served synchronously here,
+        slot by slot) — then stop. Every accepted Future resolves."""
+        leftovers = self._stop_dispatcher(timeout)
+        if self._thread is not None:
+            # bounded join expired with the dispatcher still serving:
+            # it finishes and resolves everything it holds on its own
+            # thread (_serve_out) — two threads must not step the
+            # same sessions
+            return
+        # dispatcher never started or was wedged: serve the remainder
+        # here, co-batching waiting requests into free slots (placing
+        # one-at-a-time would run each generation solo and forfeit
+        # the batching this layer exists for)
+        self._pending.extend(leftovers)
+        while self._pending or self._active:
+            progressed = False
+            while self._pending:
+                if not self._place(self._pending.popleft()):
+                    break  # head parked again: a step must free slots
+                progressed = True
+            if self._active:
+                self._step_all()
+            elif not progressed and self._pending:
+                # unplaceable with nothing in flight (external slot
+                # holders): resolve rather than spinning forever
+                parked = self._pending.popleft()
+                _resolve(parked.future,
+                         exception=ServingUnavailableError(
+                             "drain: no session could take the "
+                             "request"))
+
+    def close(self, timeout=5.0):
+        """Fast exit: a live dispatcher serves out everything it owns
+        (active slots AND accepted submits) before exiting — past the
+        bounded join it keeps doing so on its own thread — so no
+        accepted Future is ever left hanging; with no dispatcher
+        running, queued requests are failed instead."""
+        for item in self._stop_dispatcher(timeout):
+            _resolve(item.future,
+                     exception=RuntimeError("scheduler closed"))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
