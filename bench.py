@@ -748,6 +748,81 @@ def bench_generation(on_accel):
     }]
 
 
+def bench_generation_failover(on_accel):
+    """Fault-to-resumed-decode latency of token-replay failover
+    (ISSUE 10): a mid-decode session kill re-queues the request and
+    re-prefills its journal (prompt ⊕ tokens-so-far); the recovery
+    number is re-queue wait + replay prefill, read per trial off the
+    ``paddle_generation_failover_recovery_seconds`` histogram. Lower
+    is better; a noise floor keeps ms-scale CPU scheduler jitter from
+    tripping the wire."""
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+    from paddle_tpu.models.transformer import (transformer_lm_generate,
+                                               transformer_lm_session)
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving.generation import (GenerationScheduler,
+                                               GenerationSession)
+
+    kw = dict(d_model=512, num_heads=8, d_ff=2048, num_layers=4) \
+        if on_accel else dict(d_model=64, num_heads=2, d_ff=128,
+                              num_layers=2)
+    vocab = 1024 if on_accel else 64
+    max_len = 32
+    suffix = "" if on_accel else "_cpu_smoke"
+
+    with ptpu.unique_name.guard():
+        main_prog, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main_prog, startup):
+            anchor = layers.data("anchor", shape=[1], dtype="int32")
+            transformer_lm_generate(anchor, vocab_size=vocab,
+                                    max_len=max_len, beam_size=1, **kw)
+    exe = ptpu.Executor()
+    exe.run(startup)
+
+    spec = transformer_lm_session(vocab, max_len=max_len, slots=2,
+                                  cache_len=max_len,
+                                  prompt_buckets=(8, 16), **kw)
+    sess = GenerationSession(spec)
+    sess.generate([0], max_new_tokens=2, eos_id=-1)  # warm compiles
+    hist = metrics.REGISTRY.histogram(
+        "paddle_generation_failover_recovery_seconds")._default()
+    sched = GenerationScheduler(sess, replay_attempts=2)
+    recov_ms = []
+    try:
+        for trial in range(7):
+            c0, s0 = hist.count, hist.sum
+            # one-shot mid-decode kill: the request replays (same
+            # session — no breakers, so placement re-admits it there
+            # and the exhausted fault lets it finish)
+            faults.arm("generation_step_fail", times=1)
+            fut = sched.submit([0, 2 + trial], max_new_tokens=8,
+                               eos_id=-1)
+            if len(fut.result(timeout=300)) != 8:
+                raise RuntimeError("failover bench request truncated")
+            faults.disarm()
+            if hist.count != c0 + 1:
+                raise RuntimeError(
+                    "expected exactly one replay recovery, got %d"
+                    % (hist.count - c0))
+            recov_ms.append((hist.sum - s0) * 1e3)
+    finally:
+        faults.disarm()
+        sched.close()
+    return {
+        "metric": "generation_failover_recovery_ms" + suffix,
+        "value": round(float(np.median(recov_ms)), 2),
+        "unit": "ms fault->resumed decode (re-queue + replay prefill)",
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        "trials": len(recov_ms),
+        # the replay prefill is one small-batch step: host jitter
+        # dominates relative drift below this
+        "regression_floor": 5.0,
+    }
+
+
 def bench_elastic_resume():
     """Measure the elastic control plane's recovery latency on this
     host: a registered peer goes silent, the master declares it dead
@@ -874,7 +949,9 @@ def main():
             ("cold_start_ms",
              lambda: bench_deploy(on_accel)),
             ("decode_tokens_per_sec",
-             lambda: bench_generation(on_accel))]:
+             lambda: bench_generation(on_accel)),
+            ("generation_failover_recovery_ms",
+             lambda: bench_generation_failover(on_accel))]:
         try:
             out = _isolated(fn)
             for line in (out if isinstance(out, list) else [out]):

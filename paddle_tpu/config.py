@@ -133,6 +133,48 @@ generation_prompt_buckets: defaults for the autoregressive generation
   one per prompt bucket, however many requests flow. Read only at
   session construction — generation unused costs zero flag checks
   anywhere.
+
+generation_replay_attempts: default token-replay failover budget for
+  GenerationScheduler. 0 (default) = off: a session failure resolves
+  its in-flight requests exceptionally (the pre-replay behavior).
+  N > 0: a request whose session fails mid-generation is re-queued
+  head-of-line carrying its replay journal (prompt + every token
+  generated so far) and re-admitted into a healthy session — the
+  prefill of ``prompt ⊕ tokens`` recomputes the exact decode state, so
+  greedy output stays token-for-token identical to a fault-free run —
+  up to N times before the original failure surfaces. The deadline is
+  unchanged across replays (recovery spends the same budget). Read
+  only at scheduler construction.
+
+generation_rebuild_limit: how many background teardown/reconstruct
+  cycles a quarantined GenerationSession gets (0 = default = off:
+  quarantine is permanent until a cooldown trial succeeds). A session
+  whose trial re-admissions keep failing — or that wedged past the
+  step timeout — is rebuilt in the background: fresh cache variables
+  in a fresh namespace (a leaked wedged step can never scribble on the
+  new session's state), params re-read from the scope, warmup
+  prefill + decode before it re-enters placement. Requires the spec to
+  carry a ``rebuild`` factory (transformer_lm_session provides one).
+  Read only at scheduler construction.
+
+generation_step_timeout_ms: per-session decode-step timeout for the
+  GenerationScheduler dispatcher (0 = default = off: step() runs
+  inline, the pre-timeout hot path). When set, each session's step is
+  bounded by a worker thread (serving/resilience.py run_bounded): a
+  hang past the timeout is treated as a failure — the session's
+  requests replay elsewhere, its breaker opens (hang = instant open,
+  the PR-5 rule), and the wedged session is excluded from placement
+  with its stuck thread leaked-and-capped at one — so one wedged
+  step() can no longer freeze every other session and the deadline
+  sweeps. Read only at scheduler construction.
+
+compile_cache_max_bytes: 0 (default) = the persistent compile cache
+  dir grows without bound (the pre-cap behavior). When set, store()
+  evicts coldest-mtime entries (bin+manifest together; load() hits
+  touch mtime, so this is LRU, not FIFO) until the dir fits, never
+  evicting the entry it just published. Evictions are counted in
+  ``paddle_deploy_cache_evictions_total``. Only consulted on the
+  store path — cache-off means zero flag reads.
 """
 
 import jax
@@ -173,6 +215,14 @@ _flags = {
     "generation_slots": 4,
     "generation_cache_buckets": (128,),
     "generation_prompt_buckets": (16,),
+    # stateful-generation resilience (serving/generation.py; read only
+    # at scheduler construction — defaults keep the PR-8 dispatcher
+    # hot path and failure behavior byte-identical)
+    "generation_replay_attempts": 0,
+    "generation_rebuild_limit": 0,
+    "generation_step_timeout_ms": 0,
+    # persistent compile cache size cap (core/compile_cache.py)
+    "compile_cache_max_bytes": 0,
 }
 
 # Observers called with the flag dict after every set_flags (the

@@ -33,9 +33,18 @@ Trust boundary: the serialized executable format pickles XLA-internal
 objects, so (unlike the data-only ``__model__`` JSON) cache dirs and
 ``compiled/`` artifact members must come from a writer you trust.
 
+**Size bound** (``compile_cache_max_bytes`` flag; 0 = unbounded):
+``store()`` publishes its entry first, then evicts coldest entries —
+``.bin`` and manifest together, ordered by mtime, which ``load()``
+touches on every hit so the ordering is least-recently-USED — until
+the dir fits. The just-published entry is never evicted (a cap
+smaller than one entry must not make the cache thrash itself empty),
+and eviction is store-path-only: a capped dir costs nothing on the
+hit path beyond the mtime touch.
+
 Counters (always-on; every event here is a cold-start event, never a
 per-step cost): ``paddle_deploy_cache_hits_total`` /
-``_misses_total`` / ``_quarantined_total``.
+``_misses_total`` / ``_quarantined_total`` / ``_evictions_total``.
 """
 
 import hashlib
@@ -64,6 +73,10 @@ CACHE_QUARANTINED = _metrics.REGISTRY.counter(
     "paddle_deploy_cache_quarantined_total",
     "Persistent compile-cache entries moved to corrupt_* after failing "
     "digest verification or deserialization")
+CACHE_EVICTIONS = _metrics.REGISTRY.counter(
+    "paddle_deploy_cache_evictions_total",
+    "Persistent compile-cache entries evicted (mtime-LRU) to keep the "
+    "dir under compile_cache_max_bytes")
 
 
 class _CorruptEntry(Exception):
@@ -147,8 +160,12 @@ class PersistentCompileCache:
     """Directory of serialized executables, one ``entry_<digest>.bin``
     + ``entry_<digest>.json`` manifest per compile-cache entry."""
 
-    def __init__(self, dirname):
+    def __init__(self, dirname, max_bytes=0):
         self.dirname = str(dirname)
+        # 0 = unbounded; refreshed from the compile_cache_max_bytes
+        # flag by active_cache() so a flag change applies to the
+        # already-constructed instance
+        self.max_bytes = int(max_bytes or 0)
         self._serialize_unsupported = False  # log the first failure only
 
     def _bin(self, digest):
@@ -193,6 +210,13 @@ class PersistentCompileCache:
             CACHE_MISSES.inc()
             return None
         CACHE_HITS.inc()
+        try:
+            # LRU touch: a hit entry must outrank write-once-read-
+            # never entries when the size cap evicts by mtime
+            os.utime(bin_path)
+            os.utime(meta_path)
+        except OSError:
+            pass
         return compiled
 
     def store(self, digest, compiled):
@@ -221,7 +245,53 @@ class PersistentCompileCache:
             _log.structured("compile_cache_store_failed", digest=digest,
                             error=repr(e))
             return False
+        self._evict_lru(keep_digest=digest)
         return True
+
+    def _evict_lru(self, keep_digest):
+        """Bound the dir to ``max_bytes``: drop whole entries (bin +
+        manifest together — a half-evicted entry is just a future
+        manifestless miss) coldest-mtime first until the cap fits.
+        The entry just published is exempt: a cap smaller than one
+        executable must degrade to "cache of one", not evict the
+        thing it was asked to keep. Best-effort like store() itself —
+        a concurrent writer/evictor losing a race is no error."""
+        if not self.max_bytes:
+            return
+        try:
+            entries = {}  # digest -> [mtime, bytes, paths]
+            for fname in os.listdir(self.dirname):
+                # skip quarantine evidence (bounded separately) and a
+                # concurrent writer's in-flight temp files
+                if not fname.startswith("entry_") or ".tmp." in fname:
+                    continue
+                digest = fname[len("entry_"):].rsplit(".", 1)[0]
+                path = os.path.join(self.dirname, fname)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                ent = entries.setdefault(digest, [0.0, 0, []])
+                ent[0] = max(ent[0], st.st_mtime)
+                ent[1] += st.st_size
+                ent[2].append(path)
+            total = sum(e[1] for e in entries.values())
+            for digest in sorted(entries, key=lambda d: entries[d][0]):
+                if total <= self.max_bytes:
+                    break
+                if digest == keep_digest:
+                    continue
+                for path in entries[digest][2]:
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+                total -= entries[digest][1]
+                CACHE_EVICTIONS.inc()
+                _log.structured("compile_cache_evicted", digest=digest,
+                                freed_bytes=entries[digest][1])
+        except OSError:
+            pass
 
     def _quarantine(self, digest, reason):
         """Move a corrupt entry aside (evidence, like checkpoint
@@ -286,4 +356,8 @@ def active_cache():
         if cache is None:
             cache = PersistentCompileCache(dirname)
             _ACTIVE[dirname] = cache
+        # store-path-only flag (load never consults it): refresh here
+        # so a flag change reaches the cached instance
+        cache.max_bytes = int(
+            _config.get_flag("compile_cache_max_bytes") or 0)
         return cache

@@ -258,6 +258,18 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
         nxt = layers.argmax(row, axis=-1)
     decode_fetch = nxt.name
 
+    def _rebuild():
+        # the session-rebuild factory (serving.generation): identical
+        # programs/parameters, but cache_ns=None forces a FRESH cache
+        # namespace — a wedged step leaked from the torn-down session
+        # can only ever write to the old, orphaned names
+        return transformer_lm_session(
+            vocab_size, d_model=d_model, num_heads=num_heads,
+            d_ff=d_ff, num_layers=num_layers, max_len=max_len,
+            slots=slots, cache_len=cache_len,
+            prompt_buckets=prompt_buckets, bos_id=bos_id,
+            eos_id=eos_id, cache_ns=None, dtype=dtype)
+
     return GenerationSpec(
         slots=slots, cache_len=cache_len, max_len=max_len,
         prompt_buckets=prompt_buckets, bos_id=bos_id, eos_id=eos_id,
@@ -269,4 +281,5 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
         prefill_fetch=prefill_fetch,
         decode_program=decode_program,
         decode_feeds=("gen.dtok", "gen.dpos"),
-        decode_fetch=decode_fetch)
+        decode_fetch=decode_fetch,
+        rebuild=_rebuild)

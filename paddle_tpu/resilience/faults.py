@@ -65,6 +65,22 @@ site                          where / what
 ``swap_canary_fail``          ServingEngine.swap_weights, before the
                               canary execution — simulates a push whose
                               weights fail on real traffic shapes
+``generation_step_fail``      GenerationScheduler decode dispatch, before
+                              the session's step() — ``index`` is the
+                              SESSION number. Arm with ``times=None`` for
+                              persistent mode (the session is broken until
+                              disarmed): the replay-failover / session-
+                              rebuild chaos shape
+``generation_admit_fail``     GenerationScheduler, before a prompt's
+                              prefill admission — indexed by session; a
+                              raising spec makes admission (including a
+                              replay re-admission) fail there
+``generation_session_wedge``  GenerationScheduler, inside the (possibly
+                              worker-bounded) step dispatch — arm with
+                              ``action="callback"`` sleeping past
+                              ``generation_step_timeout_ms`` to simulate a
+                              wedged decode step; only the step-timeout
+                              escalation gets the dispatcher out
 ============================  =============================================
 
 Actions: ``"raise"`` (raise ``exc``, default :class:`InjectedFault`),
@@ -100,7 +116,10 @@ class FaultSpec:
             raise ValueError("action='callback' needs a callback")
         self.site = site
         self.at = at          # index (step/batch) to fire at; None = any
-        self.times = times    # remaining firings
+        # remaining firings; None = persistent (fires on every match
+        # until disarmed — the "session is broken, not glitching"
+        # chaos shape)
+        self.times = times
         self.action = action
         self.exc = exc
         self.callback = callback
@@ -111,7 +130,10 @@ _ARMED = {}  # site -> [FaultSpec]
 
 
 def arm(site, at=None, times=1, action="raise", exc=None, callback=None):
-    """Arm a fault (also flips the ``fault_injection`` config flag on)."""
+    """Arm a fault (also flips the ``fault_injection`` config flag on).
+    ``times=None`` arms PERSISTENT mode: the fault fires on every
+    match until ``disarm()`` — "this session/replica is broken", as
+    opposed to the counted "it glitched N times"."""
     spec = FaultSpec(site, at=at, times=times, action=action, exc=exc,
                      callback=callback)
     with _LOCK:
@@ -151,12 +173,13 @@ def should_fire(site, index=None):
         return None
     with _LOCK:
         for spec in _ARMED.get(site, ()):
-            if spec.times <= 0:
+            if spec.times is not None and spec.times <= 0:
                 continue
             if spec.at is not None and index is not None \
                     and spec.at != index:
                 continue
-            spec.times -= 1
+            if spec.times is not None:
+                spec.times -= 1
             return spec
     return None
 

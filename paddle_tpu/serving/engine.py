@@ -300,18 +300,15 @@ class ServingEngine:
         return outs
 
     def _execute_timed(self, rep, feed, bucket, timeout):
-        """Run ``_execute`` bounded by ``timeout`` seconds. One worker
-        thread is spawned per timed dispatch — ~e-5 s against ms-scale
-        batch executions (measured within noise, PROFILE.md round 9),
-        and the simplest structure that survives a wedged run: a hung
-        device execution can't be cancelled, so it is left to finish on
-        its worker thread while the caller gets ServingTimeoutError — the
-        breaker quarantines the replica (whose lock the hung run still
-        holds) out of rotation. While that earlier worker is still
-        wedged, fail fast instead of stacking another blocked thread
-        (and its pinned feed arrays) behind the same lock — probes
-        against a wedged replica would otherwise leak one thread per
-        cooldown."""
+        """Run ``_execute`` bounded by ``timeout`` seconds via the
+        shared worker-thread pattern (``resilience.run_bounded``): a
+        hung device execution is left to finish on its worker thread
+        while the caller gets ServingTimeoutError — the breaker
+        quarantines the replica (whose lock the hung run still holds)
+        out of rotation. While that earlier worker is still wedged,
+        fail fast instead of stacking another blocked thread (and its
+        pinned feed arrays) behind the same lock — probes against a
+        wedged replica would otherwise leak one thread per cooldown."""
         with rep.guard:
             prior = rep.stuck
             if prior is not None:
@@ -321,32 +318,20 @@ class ServingEngine:
                     raise ServingTimeoutError(
                         "replica %d still wedged in an earlier "
                         "execution" % rep.index)
-        result = {}
-        done = threading.Event()
-
-        def work():
-            try:
-                result["outs"] = self._execute(rep, feed, bucket)
-            except BaseException as exc:
-                result["exc"] = exc
-            finally:
-                done.set()
-
-        worker = threading.Thread(target=work, daemon=True,
-                                  name="serving-exec-%d" % rep.index)
-        worker.start()
-        if not done.wait(timeout):
-            with rep.guard:
-                # keep the FIRST still-unset marker: concurrent timed
-                # calls must not overwrite it with a later one
-                if rep.stuck is None or rep.stuck.is_set():
-                    rep.stuck = done
-            raise ServingTimeoutError(
-                "replica %d exceeded the %.3fs execution timeout"
-                % (rep.index, timeout))
-        if "exc" in result:
-            raise result["exc"]
-        return result["outs"]
+        try:
+            return _sres.run_bounded(
+                lambda: self._execute(rep, feed, bucket), timeout,
+                name="serving-exec-%d" % rep.index)
+        except ServingTimeoutError as err:
+            pending = getattr(err, "pending", None)
+            if pending is not None:
+                with rep.guard:
+                    # keep the FIRST still-unset marker: concurrent
+                    # timed calls must not overwrite it with a later
+                    # one
+                    if rep.stuck is None or rep.stuck.is_set():
+                        rep.stuck = pending
+            raise
 
     def _run_once(self, rep, arrays, bucket, timeout):
         t0 = time.perf_counter()

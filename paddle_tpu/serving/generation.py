@@ -37,18 +37,62 @@ machinery:
   composed with stateful sessions: the flip lands on a step boundary,
   so no single forward pass ever mixes weight versions).
 
+Stateful failure recovery (the zero-client-error contract the
+stateless tier has had since PR 5): a session's KV cache is *derived*
+state — each request's host-side ``prompt`` + ``tokens`` list is a
+complete, deterministic replay journal — so a session fault does not
+have to surface to clients:
+
+* **token-replay failover** (``replay_attempts`` /
+  ``generation_replay_attempts`` flag): when a session's ``step()``
+  or ``admit()`` fails, its in-flight requests are re-queued
+  head-of-line carrying their journal; re-admission prefills
+  ``prompt ⊕ tokens-so-far`` into a healthy session (promoting to a
+  larger prompt bucket when the history outgrew the original one) and
+  decoding continues. Greedy decode is deterministic, so the final
+  output is token-for-token identical to a fault-free run. Replays
+  are bounded per request, the absolute deadline is unchanged across
+  them (recovery spends the caller's budget), and a poison prompt
+  charges at most one breaker across all its replays — it cannot
+  black out every session (the PR-5/7 lesson).
+* **session rebuild** (``rebuild_limit`` /
+  ``generation_rebuild_limit`` flag): a quarantined session whose
+  trial re-admissions keep failing — or that wedged past the step
+  timeout — is torn down and reconstructed on a background thread:
+  fresh cache variables under a fresh namespace (``spec.rebuild()``;
+  a leaked wedged step finishing late scribbles only on orphaned
+  names), params re-read from the scope, warmup prefill + decode, and
+  an atomic swap into placement on the dispatcher thread. Bounded per
+  session: quarantine becomes repair, not amputation.
+* **hang-free dispatch** (``step_timeout_ms`` /
+  ``generation_step_timeout_ms`` flag): each session's step is
+  bounded by the serving tier's worker-thread-timeout pattern
+  (``resilience.run_bounded``), so one wedged ``step()`` no longer
+  freezes every session and every deadline sweep — a hang is a
+  failure (requests replay elsewhere, the breaker opens instantly)
+  and the wedged session sits out of placement with its stuck thread
+  leaked-and-capped at one.
+
 Nothing here is constructed by default flags: with no session built,
 the serving fast path, the batcher, and the executor step are
-untouched (the generation_* flags are read only inside constructors).
+untouched (the generation_* flags are read only inside constructors),
+and with the replay/rebuild/timeout flags at their defaults the
+dispatcher loop is the pre-recovery hot path — no flag reads, no
+worker threads, failures resolve exceptionally as before.
 
 Metrics (always-on, like the serving front door):
 ``paddle_generation_requests_total``, ``_tokens_total``,
 ``_prefills_total``, ``_decode_steps_total``,
 ``_retired_total{reason}``, ``_slot_occupancy``,
 ``_ttft_seconds`` (time to first token), ``_inter_token_seconds``,
-``_request_seconds``. Shed/deadline events share the serving counters
+``_request_seconds``; recovery: ``_failover_total``,
+``_replayed_tokens_total``, ``_session_rebuilds_total``,
+``_step_timeouts_total``, ``_failover_recovery_seconds``.
+Shed/deadline events share the serving counters
 (``paddle_serving_shed_total`` / ``_deadline_exceeded_total``).
-Fault site: ``generation_step_fail`` (indexed by session).
+Fault sites: ``generation_step_fail`` (persistent with
+``times=None``), ``generation_admit_fail``,
+``generation_session_wedge`` — all indexed by session.
 """
 
 import collections
@@ -107,8 +151,33 @@ _INTER_TOKEN_SECONDS = _metrics.REGISTRY.histogram(
 _REQUEST_SECONDS = _metrics.REGISTRY.histogram(
     "paddle_generation_request_seconds",
     "Submit -> Future resolution for completed generations")
+_FAILOVERS = _metrics.REGISTRY.counter(
+    "paddle_generation_failover_total",
+    "Requests re-queued for token-replay after their session failed "
+    "(each re-admits into a healthy session, output unchanged)")
+_REPLAYED_TOKENS = _metrics.REGISTRY.counter(
+    "paddle_generation_replayed_tokens_total",
+    "Already-generated tokens re-prefilled by replay re-admissions")
+_REBUILDS = _metrics.REGISTRY.counter(
+    "paddle_generation_session_rebuilds_total",
+    "Quarantined sessions torn down and reconstructed (fresh cache "
+    "namespace, warmed) back into placement")
+_STEP_TIMEOUTS = _metrics.REGISTRY.counter(
+    "paddle_generation_step_timeouts_total",
+    "Decode steps that exceeded generation_step_timeout_ms (session "
+    "quarantined with its worker thread leaked-and-capped)")
+_RECOVERY_SECONDS = _metrics.REGISTRY.histogram(
+    "paddle_generation_failover_recovery_seconds",
+    "Session failure -> the replayed request decoding again on a "
+    "healthy session (re-queue wait + replay prefill)")
 
 _STOP = object()
+
+# trial re-admission failures after quarantine before a session is
+# torn down and rebuilt (when rebuild is armed): the first failed
+# trial may be the tail of a transient; the second says the session
+# itself is broken
+_REBUILD_AFTER_TRIALS = 2
 
 # distinguishes per-session breaker gauge labels across schedulers
 _SCHED_SEQ = itertools.count()
@@ -135,14 +204,22 @@ class GenerationSpec:
       (tokens, positions).
     * ``cache_vars``: ((name, shape, dtype), ...) persistable cache
       variables a session materializes as device zeros in its scope.
+    * ``rebuild`` (optional): zero-arg factory returning an equivalent
+      fresh spec under a NEW cache namespace — what session rebuild
+      constructs the replacement from. A fresh namespace is
+      load-bearing, not cosmetic: a wedged step leaked on its worker
+      thread may finish long after the rebuild and republish the OLD
+      cache names into the scope; under a new namespace those writes
+      land on orphaned variables, never on the replacement's state.
     """
 
     __slots__ = ("slots", "cache_len", "max_len", "prompt_buckets",
                  "bos_id", "eos_id", "cache_vars", "prefill_programs",
                  "prefill_feeds", "prefill_fetch", "decode_program",
-                 "decode_feeds", "decode_fetch")
+                 "decode_feeds", "decode_fetch", "rebuild")
 
     def __init__(self, **kwargs):
+        kwargs.setdefault("rebuild", None)
         for name in self.__slots__:
             setattr(self, name, kwargs.pop(name))
         if kwargs:
@@ -169,6 +246,7 @@ class GenerationSession:
         import jax.numpy as jnp
         self.spec = spec
         self.scope = scope if scope is not None else global_scope()
+        self.place = place  # kept so a rebuild lands on the same device
         self.exe = Executor(place=place)
         names = {name for name, _, _ in spec.cache_vars}
         claimed = _CACHE_CLAIMS.setdefault(self.scope, set())
@@ -334,7 +412,8 @@ class GenerationSession:
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "explicit_budget", "eos_id",
                  "future", "deadline", "t_submit", "tokens", "slot",
-                 "session_index", "t_last")
+                 "session_index", "t_last", "t_queued", "replays",
+                 "charged", "failed_on", "last_exc")
 
     def __init__(self, prompt, max_new, explicit_budget, eos_id,
                  deadline):
@@ -349,10 +428,42 @@ class _GenRequest:
         self.future = Future()
         self.deadline = deadline  # absolute time.monotonic() or None
         self.t_submit = time.perf_counter()
+        # last enqueue time: t_submit at first, reset on a replay
+        # re-queue so the admission-wait EWMA keeps measuring QUEUE
+        # wait, not time-since-original-submit (a replay would
+        # otherwise latch the shed estimate high); the deadline keeps
+        # using t_submit — replay spends the caller's budget
+        self.t_queued = self.t_submit
         self.tokens = []
         self.slot = None
         self.session_index = None
         self.t_last = None
+        self.replays = 0      # replay re-admissions consumed
+        # True once this request's own failure charged a breaker: a
+        # poison prompt failing over across sessions charges at most
+        # ONE — it cannot quarantine the whole fleet
+        self.charged = False
+        # sessions this request has already failed on: replay
+        # re-placement prefers anything else first. Without this, a
+        # sub-threshold breaker (still closed after the charge) keeps
+        # winning lowest-index placement and the request burns its
+        # whole replay budget on the one broken session while a
+        # healthy one sits idle.
+        self.failed_on = set()
+        # the failure that parked this request for replay: if the
+        # replay turns out to be impossible (journal outgrew every
+        # prompt bucket, no session ever heals), THIS surfaces — not
+        # a generic unavailable error that masks what happened
+        self.last_exc = None
+
+    def history(self):
+        """The replay journal: prompt plus every token generated so
+        far — prefilling it reconstructs the exact decode state (and
+        the next prefill token IS the token the failed step owed)."""
+        if not self.tokens:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int64)])
 
 
 class GenerationScheduler:
@@ -378,10 +489,17 @@ class GenerationScheduler:
 
     With ``breaker_failures`` (default: the
     ``serving_breaker_failures`` flag; 0 = off) each session gets a
-    :class:`ReplicaBreaker`: a failing session's active requests fail
-    over is impossible (their cache state died with the session), so
-    they resolve exceptionally, the session is quarantined out of
-    admission, and a cooldown-gated trial prefill re-admits it.
+    :class:`ReplicaBreaker`: a failing session is quarantined out of
+    admission and a cooldown-gated trial re-admits it. Its active
+    requests' device-side cache died with it, but their host-side
+    journals didn't: with ``replay_attempts`` > 0 (default: the
+    ``generation_replay_attempts`` flag) they re-queue head-of-line
+    and re-prefill ``prompt ⊕ tokens`` into a healthy session —
+    token-for-token identical output, zero client-visible errors;
+    with replay off they resolve exceptionally. ``step_timeout_ms``
+    bounds each session's step so one wedged device call can't freeze
+    the dispatcher, and ``rebuild_limit`` lets a broken session be
+    reconstructed in the background (see the module docstring).
 
     ``drain()`` stops admission and serves everything accepted;
     ``close()`` is the bounded fast exit. ``swap_weights(params)``
@@ -390,7 +508,8 @@ class GenerationScheduler:
 
     def __init__(self, sessions, max_queue=256, deadline_ms=None,
                  breaker_failures=None, breaker_cooldown_ms=None,
-                 autostart=True):
+                 replay_attempts=None, rebuild_limit=None,
+                 step_timeout_ms=None, autostart=True):
         if isinstance(sessions, GenerationSession):
             sessions = [sessions]
         if not sessions:
@@ -420,13 +539,43 @@ class GenerationScheduler:
             breaker_cooldown_ms = _config.get_flag(
                 "serving_breaker_cooldown_ms")
         if breaker_failures:
+            # namespaced like the engine tier's "e<N>:<replica>" (PR
+            # 7): a process running serving engines AND generation
+            # schedulers publishes both families of per-replica health
+            # gauges on the one registry — "g<N>:<session>" keeps them
+            # from overwriting each other
             self._breakers = [
                 ReplicaBreaker(i, breaker_failures,
                                float(breaker_cooldown_ms) / 1e3,
-                               label="gen%d:%d" % (self._sched_id, i))
+                               label="g%d:%d" % (self._sched_id, i))
                 for i in range(len(self.sessions))]
         else:
             self._breakers = None
+        # -- stateful-failure recovery (flags read HERE only: the
+        # dispatcher loop never consults config, and at the defaults
+        # none of the machinery below is exercised) ---------------------
+        if replay_attempts is None:
+            replay_attempts = _config.get_flag(
+                "generation_replay_attempts")
+        self.replay_attempts = int(replay_attempts or 0)
+        if rebuild_limit is None:
+            rebuild_limit = _config.get_flag("generation_rebuild_limit")
+        self.rebuild_limit = int(rebuild_limit or 0)
+        if step_timeout_ms is None:
+            step_timeout_ms = _config.get_flag(
+                "generation_step_timeout_ms")
+        self.step_timeout = (float(step_timeout_ms) / 1e3
+                             if step_timeout_ms else None)
+        self._wedged = {}        # si -> done-Event of the leaked step
+        self._rebuilding = set()  # session indices down for rebuild
+        # True only once NOTHING will absorb rebuilds anymore (the
+        # dispatcher exited, or a dispatcherless close()/drain()
+        # finished serving) — _closed alone is not it: a draining
+        # scheduler is closed to admission but still absorbing
+        self._terminal = False
+        self._rebuilt = queue.Queue()  # (si, session|None, err, secs)
+        self._rebuilds = [0] * len(self.sessions)
+        self._trial_failures = [0] * len(self.sessions)
         self._swap_lock = threading.Lock()
         self._pending_swap = None  # (params, Future)
         self._weights_version = 0
@@ -553,22 +702,53 @@ class GenerationScheduler:
         early with reason 'capacity', under-delivering the budget
         submit() accepted. An implicit ("as much as fits") budget is
         satisfied by ANY fitting session — requiring the largest
-        session's cap would strand idle smaller replicas."""
-        n = item.prompt.size
-        need = item.max_new if item.explicit_budget else 1
+        session's cap would strand idle smaller replicas.
+
+        A replay re-admission prefills the whole journal (prompt plus
+        tokens already generated), so its length — and therefore its
+        prompt bucket, possibly a larger one than the original
+        admission used — and its REMAINING budget are what must fit.
+        For a fresh item both reduce to the original check."""
+        n = item.prompt.size + len(item.tokens)
+        need = max(1, item.max_new - len(item.tokens)) \
+            if item.explicit_budget else 1
         return sess.prompt_bucket(n) is not None and \
             sess.max_pos - n + 1 >= need
+
+    def _is_wedged(self, si):
+        """True while session ``si``'s timed-out step worker is still
+        stuck — it must not be stepped or admitted into (its executor
+        and cache state are mid-flight). Once the leaked worker
+        finishes, the marker clears; the breaker (opened by the hang)
+        still gates re-admission through a cooldown trial."""
+        ev = self._wedged.get(si)
+        if ev is None:
+            return False
+        if ev.is_set():
+            self._wedged.pop(si, None)
+            return False
+        return True
 
     def _eligible_session(self, item, claim=False):
         """Index of a session that can take this request NOW
         (free slot + fitting bucket/capacity + breaker closed, or a
         cooldown-elapsed trial when nothing fitting is closed), or
-        None. The half_open transition — a trial admission is the
-        probe — fires only with ``claim=True``, i.e. when an actual
-        request is about to be admitted; a capacity poll must not
-        burn a breaker's cooldown with no trial to run."""
+        None. Wedged and mid-rebuild sessions are never eligible. The
+        half_open transition — a trial admission is the probe — fires
+        only with ``claim=True``, i.e. when an actual request is about
+        to be admitted; a capacity poll must not burn a breaker's
+        cooldown with no trial to run."""
         candidates = [i for i, s in enumerate(self.sessions)
-                      if s.free_slots() and self._fits(s, item)]
+                      if i not in self._rebuilding
+                      and not self._is_wedged(i)
+                      and s.free_slots() and self._fits(s, item)]
+        if item.failed_on:
+            # a session this request already failed on is the LAST
+            # resort, breaker state notwithstanding: its breaker may
+            # still be closed (sub-threshold after the at-most-once
+            # charge), and replaying straight back would burn the
+            # whole budget on the one broken session
+            candidates.sort(key=lambda i: i in item.failed_on)
         if not candidates:
             return None
         if self._breakers is None:
@@ -587,17 +767,55 @@ class GenerationScheduler:
                 return i
         return None
 
+    def _recovery_pending(self, item):
+        """True while a FINITE recovery will make a fitting session
+        placeable for ``item``: a rebuild hand-over is on its way, or
+        replay is armed and a fitting session's breaker is riding a
+        cooldown toward a trial. Shutdown serving (serve-out / drain)
+        waits these out instead of failing the request — the wait is
+        bounded by the cooldown/rebuild plus the item's replay
+        budget. All-closed breakers with no free slots (external slot
+        holders) are NOT recovery: nothing here ever frees them."""
+        for i, s in enumerate(self.sessions):
+            if not self._fits(s, item):
+                continue
+            if i in self._rebuilding:
+                return True
+            if self.replay_attempts and self._breakers is not None \
+                    and not self._is_wedged(i) \
+                    and self._breakers[i].state != "closed":
+                return True
+        return False
+
     def _dispatchable_later(self, item):
         """True when some session fitting this request is healthy
         (or trial-ready) but merely out of free slots — a retiring
-        sequence will make room, so the request should wait."""
+        sequence will make room — or is being rebuilt and will rejoin.
+        A still-wedged session is NOT a reason to wait: nothing drains
+        it unless a rebuild is in flight.
+
+        With replay armed, an open breaker whose cooldown is still
+        running also counts: the cooldown is finite, the trial
+        admission is how the session re-enters, and the wait is
+        bounded — by the request's deadline (the expiry sweep keeps
+        covering parked items) and by its replay budget (each failed
+        trial it is admitted into burns one). Fast-failing here
+        instead would break the zero-client-error contract for the
+        exact window recovery needs. Replay off keeps the PR-8
+        honesty: quarantine-with-cooldown-pending fails fast."""
         for i, s in enumerate(self.sessions):
             if not self._fits(s, item):
+                continue
+            if i in self._rebuilding:
+                return True
+            if self._is_wedged(i):
                 continue
             breaker = self._breakers[i] if self._breakers else None
             if breaker is None or \
                     breaker.state in ("closed", "half_open") or \
                     breaker.ready_to_probe():
+                return True
+            if self.replay_attempts and breaker.state == "open":
                 return True
         return False
 
@@ -663,23 +881,29 @@ class GenerationScheduler:
             if self._dispatchable_later(item):
                 self._pending.appendleft(item)
                 return False
-            # every fitting session is quarantined with its cooldown
-            # still running: fail explicitly rather than wedging the
-            # request in a queue nothing drains (stateful requests
-            # can't fail over mid-flight, so honesty beats hope)
-            _resolve(item.future, exception=ServingUnavailableError(
-                "no healthy generation session for this prompt"))
+            # nothing can ever take this request: fail explicitly
+            # rather than wedging it in a queue nothing drains. For a
+            # replay, surface the SESSION failure that parked it (a
+            # generic unavailable error would mask it — e.g. when the
+            # journal outgrew every prompt bucket, the caller should
+            # see why the generation actually died).
+            _resolve(item.future, exception=item.last_exc
+                     if item.last_exc is not None
+                     else ServingUnavailableError(
+                         "no healthy generation session for this "
+                         "prompt"))
             return True
         self._admit_item(item, si)
         return True
 
     def _admit_item(self, item, si):
-        wait = time.perf_counter() - item.t_submit
+        wait = time.perf_counter() - item.t_queued
         self._wait_ewma += _WAIT_ALPHA * (wait - self._wait_ewma)
         sess = self.sessions[si]
-        breaker = self._breakers[si] if self._breakers else None
+        replay = bool(item.tokens)
         try:
-            slot, first = sess.admit(item.prompt)
+            _faults.fire_point("generation_admit_fail", index=si)
+            slot, first = sess.admit(item.history())
         except ValueError as exc:
             # a client-shaped prompt (bucket/length) is the request's
             # fault, not the session's — it must not charge the
@@ -687,18 +911,24 @@ class GenerationScheduler:
             _resolve(item.future, exception=exc)
             return
         except Exception as exc:
-            if breaker is not None:
-                breaker.record_failure()
-            _resolve(item.future, exception=exc)
+            self._on_admit_failure(item, si, exc)
             return
-        if breaker is not None:
-            breaker.record_success()
+        # breaker success is recorded by a surviving STEP, not here: a
+        # persistently step-broken session would otherwise launder
+        # itself closed through every trial admission it then fails
         if item.eos_id is None:
             item.eos_id = sess.spec.eos_id
-        _REQUESTS.inc()
-        _TOKENS.inc()
         now_pc = time.perf_counter()
-        _TTFT_SECONDS.observe(now_pc - item.t_submit)
+        if replay:
+            # the same logical request, resumed — requests_total must
+            # not double-count it; the re-prefilled history is what
+            # the failover actually cost
+            _REPLAYED_TOKENS.inc(len(item.tokens))
+            _RECOVERY_SECONDS.observe(now_pc - item.t_queued)
+        else:
+            _REQUESTS.inc()
+            _TTFT_SECONDS.observe(now_pc - item.t_submit)
+        _TOKENS.inc()  # the prefill produced one NEW token either way
         item.t_last = now_pc
         item.slot = slot
         item.session_index = si
@@ -706,6 +936,57 @@ class GenerationScheduler:
         self._active[(si, slot)] = item
         self._update_occupancy()
         self._finish_if_done(item)  # EOS/budget can end it at token 1
+
+    def _on_admit_failure(self, item, si, exc):
+        """A session failed this request's (re-)admission: charge its
+        breaker (at most once per request across all its replays —
+        the poison-prompt discipline; a half-open trial failure always
+        records, the PR-5 rule), then replay the request elsewhere or
+        surface the failure when the budget is spent."""
+        breaker = self._breakers[si] if self._breakers else None
+        if breaker is not None:
+            was_trial = breaker.state == "half_open"
+            if was_trial or not item.charged:
+                breaker.record_failure()
+                item.charged = True
+            if was_trial:
+                self._trial_failures[si] += 1
+        item.failed_on.add(si)
+        _log.structured("generation_admit_failed", session=si,
+                        error=repr(exc), replay=bool(item.tokens))
+        self._maybe_rebuild(si)
+        # no slot was held here, so no retirement to count either way
+        self._requeue_for_replay([item], exc)
+
+    def _requeue_for_replay(self, items, exc):
+        """Park failed requests head-of-line for replay re-admission;
+        items whose replay budget is spent resolve with ``exc``
+        instead. Returns the list actually re-queued (slot/retirement
+        accounting stays with the caller, which knows whether the
+        items were holding slots)."""
+        requeued, spent = [], []
+        for item in items:
+            if self.replay_attempts and \
+                    item.replays < self.replay_attempts:
+                requeued.append(item)
+            else:
+                spent.append(item)
+        # appendleft in reverse keeps the failed batch's own order at
+        # the head of the parked buffer (consumed before the queue)
+        for item in reversed(requeued):
+            item.replays += 1
+            item.t_queued = time.perf_counter()
+            item.last_exc = exc
+            _FAILOVERS.inc()
+            self._pending.appendleft(item)
+        if any(item.deadline is not None for item in requeued):
+            # the expiry sweep must keep covering parked replays: a
+            # deadline that runs out while parked resolves WITHOUT
+            # ever re-prefilling
+            self._has_deadlines = True
+        for item in spent:
+            _resolve(item.future, exception=exc)
+        return requeued
 
     def _finish_if_done(self, item):
         """Retire/resolve when EOS, budget, capacity, or deadline ends
@@ -740,35 +1021,104 @@ class GenerationScheduler:
         self._update_occupancy()
         return True
 
+    def _step_session(self, si, sess):
+        """One session's decode step plus its fault hooks — shared by
+        the inline path and the bounded worker, so injected faults
+        (including a wedge callback) land inside whatever bounds the
+        step."""
+        _faults.fire_point("generation_session_wedge", index=si)
+        _faults.fire_point("generation_step_fail", index=si)
+        return sess.step()
+
+    def _step_timed(self, si, sess):
+        """Step bounded by ``self.step_timeout`` on a worker thread
+        (resilience.run_bounded). A hang raises ServingTimeoutError
+        and marks the session wedged — its stuck worker is leaked and
+        CAPPED at one: the wedge marker keeps the session out of
+        placement and stepping until the thread finishes, so retries
+        can't stack blocked threads behind a dead device call."""
+        try:
+            return _sres.run_bounded(
+                lambda: self._step_session(si, sess), self.step_timeout,
+                name="generation-step-%d" % si)
+        except _sres.ServingTimeoutError as err:
+            pending = getattr(err, "pending", None)
+            if pending is not None:
+                self._wedged[si] = pending
+            _STEP_TIMEOUTS.inc()
+            raise
+
+    def _on_session_failure(self, si, sess, mine, exc, hang=False):
+        """A session's step failed (or hung): free its slots, charge
+        its breaker once for the event, and replay the affected
+        requests into healthy sessions (default-off: they resolve
+        exceptionally, the pre-replay contract). The cache state died
+        with the session, but each request's prompt+tokens journal is
+        a complete deterministic transcript — re-prefilling it
+        elsewhere resumes the generation with identical output."""
+        breaker = self._breakers[si] if self._breakers else None
+        if breaker is not None:
+            # one breaker charge per failure EVENT (the step is the
+            # unit of failure, not the co-batched requests on it) —
+            # and at most one per REQUEST across its replays: when
+            # every affected request has already charged a breaker
+            # elsewhere, this event is those suspects re-failing (the
+            # poison shape), and charging again would let one bad
+            # request quarantine session after session. Hangs are
+            # always the session's fault, and a half-open trial
+            # failure must always record (the PR-5 rules).
+            was_trial = breaker.state == "half_open"
+            uncharged = [it for _, it in mine if not it.charged]
+            if hang or was_trial or uncharged:
+                breaker.record_failure(hang=hang)
+                for it in uncharged:
+                    it.charged = True
+            if was_trial:
+                self._trial_failures[si] += 1
+        _log.structured("generation_step_failed", session=si,
+                        error=repr(exc), hang=hang, requests=len(mine))
+        for slot, it in mine:
+            sess.retire(slot)
+            self._active.pop((si, slot), None)
+            it.failed_on.add(si)
+        items = [it for _, it in mine]
+        requeued = set()
+        if self.replay_attempts:
+            requeued = set(map(id, self._requeue_for_replay(items, exc)))
+        else:
+            for it in items:
+                _resolve(it.future, exception=exc)
+        for it in items:
+            _RETIRED.labels(
+                reason="failover" if id(it) in requeued
+                else "error").inc()
+        self._update_occupancy()
+        # a wedged session can't run cooldown trials at all — when
+        # rebuild is armed it goes straight to reconstruction
+        self._maybe_rebuild(si, force=hang)
+
     def _step_all(self):
         for si, sess in enumerate(self.sessions):
+            if si in self._rebuilding:
+                continue  # down for reconstruction; nothing is active
             mine = [(slot, it) for (s_i, slot), it
                     in list(self._active.items()) if s_i == si]
             if not mine:
                 continue
             breaker = self._breakers[si] if self._breakers else None
             try:
-                _faults.fire_point("generation_step_fail", index=si)
-                toks = sess.step()
+                if self.step_timeout is not None:
+                    toks = self._step_timed(si, sess)
+                else:
+                    toks = self._step_session(si, sess)
             except Exception as exc:
-                # a session's cache state is unrecoverable mid-flight:
-                # its requests resolve exceptionally and the breaker
-                # (when armed) quarantines the session out of
-                # admission until a trial prefill succeeds
-                if breaker is not None:
-                    breaker.record_failure()
-                _log.structured("generation_step_failed", session=si,
-                                error=repr(exc),
-                                requests=len(mine))
-                for slot, it in mine:
-                    sess.retire(slot)
-                    self._active.pop((si, slot), None)
-                    _RETIRED.labels(reason="error").inc()
-                    _resolve(it.future, exception=exc)
-                self._update_occupancy()
+                hang = isinstance(exc, _sres.ServingTimeoutError)
+                self._on_session_failure(si, sess, mine, exc,
+                                         hang=hang)
                 continue
             if breaker is not None:
                 breaker.record_success()
+                self._trial_failures[si] = 0
             _STEPS.inc()
             _TOKENS.inc(len(mine))
             now_pc = time.perf_counter()
@@ -777,6 +1127,173 @@ class GenerationScheduler:
                 _INTER_TOKEN_SECONDS.observe(now_pc - it.t_last)
                 it.t_last = now_pc
                 self._finish_if_done(it)
+
+    # -- session rebuild -------------------------------------------------
+    def _maybe_rebuild(self, si, force=False):
+        """Kick off a background teardown/reconstruct of session
+        ``si`` when it has proven broken: its post-quarantine trial
+        re-admissions keep failing (>= _REBUILD_AFTER_TRIALS), or
+        ``force`` (a wedge — trials are impossible). Bounded by
+        ``rebuild_limit`` per session; needs ``spec.rebuild``."""
+        if not self.rebuild_limit or si in self._rebuilding:
+            return
+        if self._rebuilds[si] >= self.rebuild_limit:
+            return
+        if not force and self._trial_failures[si] < _REBUILD_AFTER_TRIALS:
+            return
+        sess = self.sessions[si]
+        if sess.spec.rebuild is None:
+            return
+        if any(s_i == si for (s_i, _) in self._active):
+            return  # live requests still decoding there; next event
+        self._rebuilding.add(si)
+        self._rebuilds[si] += 1
+        threading.Thread(
+            target=self._rebuild_worker, args=(si, sess),
+            name="generation-rebuild-%d" % si, daemon=True).start()
+
+    # Bound on one rebuild's construct + warmup (covers fresh XLA
+    # compiles, which reach tens of seconds on a real chip): a rebuild
+    # was triggered because the session was broken — possibly a DEAD
+    # device — and an unbounded warmup against it would pin
+    # _rebuilding forever, parking every request that fits only this
+    # session and spinning shutdown serving for good.
+    REBUILD_TIMEOUT = 120.0
+
+    def _rebuild_worker(self, si, old_sess):
+        """Background thread: construct the replacement session —
+        fresh spec (new cache namespace), params re-read from the same
+        scope, cache zeros re-materialized — and warm every prompt
+        bucket's prefill plus the decode program so the executor
+        compiles land before it takes traffic. The whole build is
+        bounded by REBUILD_TIMEOUT (a dead device must fail the
+        rebuild, not hang it). Hand-over happens on the dispatcher
+        thread (_absorb_rebuilds); only the build runs here."""
+        t0 = time.perf_counter()
+        # abandon handshake: the builder COMMITS its session and the
+        # timed-out waiter ABANDONS under one lock, and whichever
+        # loses the race releases the session — a build finishing in
+        # the instant the bounded wait gives up must not leak its
+        # cache claims/arrays into nowhere
+        state = {"abandoned": False, "new": None}
+        state_lock = threading.Lock()
+
+        def build():
+            new = None
+            try:
+                spec = old_sess.spec.rebuild()
+                new = GenerationSession(spec, scope=old_sess.scope,
+                                        place=old_sess.place)
+                # warm EVERY prompt bucket plus the decode program:
+                # the hand-over must not leave a bucket whose first
+                # live (or replay-promoted) request pays an XLA
+                # compile stall on the dispatcher thread
+                for bucket in spec.prompt_buckets:
+                    n = max(1, min(int(bucket), new.max_pos))
+                    slot, _ = new.admit([spec.bos_id] * n)
+                    new.retire(slot)
+                slot, _ = new.admit([spec.bos_id])
+                new.step()
+                new.retire(slot)
+            except BaseException:
+                if new is not None:
+                    try:
+                        new.close()
+                    except Exception:
+                        pass
+                raise
+            with state_lock:
+                if not state["abandoned"]:
+                    state["new"] = new  # committed
+                    return new
+            # the bounded wait gave up on us: release rather than
+            # hand a session to nobody
+            try:
+                new.close()
+            except Exception:
+                pass
+            return None
+
+        try:
+            new = _sres.run_bounded(
+                build, self.REBUILD_TIMEOUT,
+                name="generation-rebuild-build-%d" % si)
+        except Exception as exc:
+            with state_lock:
+                state["abandoned"] = True
+                committed = state["new"]
+                state["new"] = None
+            if committed is not None:
+                # the build committed in the instant we gave up
+                try:
+                    committed.close()
+                except Exception:
+                    pass
+            self._rebuilt.put((si, None, exc,
+                               time.perf_counter() - t0))
+            return
+        if new is None:  # abandoned race: already released
+            self._rebuilt.put((si, None,
+                               RuntimeError("rebuild abandoned"),
+                               time.perf_counter() - t0))
+            return
+        if self._terminal:
+            # the scheduler is fully shut down mid-build (a merely
+            # DRAINING scheduler still absorbs — parked requests may
+            # be waiting on exactly this hand-over): nobody will
+            # absorb the replacement — release its cache
+            # claims/arrays instead of leaking them
+            try:
+                new.close()
+            except Exception:
+                pass
+            self._rebuilding.discard(si)
+            return
+        self._rebuilt.put((si, new, None, time.perf_counter() - t0))
+        if self._terminal:
+            # shutdown raced the put past its final sweep: drain our
+            # own hand-over (idempotent with that sweep)
+            self._drain_rebuilt()
+
+    def _absorb_rebuilds(self):
+        """Dispatcher-thread hand-over: swap finished rebuilds into
+        the session list (the dispatcher is the only session caller,
+        so the swap is race-free) and re-admit them."""
+        if not self._rebuilding:
+            # nothing can be in the queue (entries join _rebuilding
+            # before their worker starts): the default-off dispatcher
+            # tick pays one truthiness check, not a queue lock +
+            # caught queue.Empty
+            return
+        while True:
+            try:
+                si, new, err, secs = self._rebuilt.get_nowait()
+            except queue.Empty:
+                return
+            self._rebuilding.discard(si)
+            if new is None:
+                _log.structured("generation_rebuild_failed",
+                                session=si, error=repr(err),
+                                rebuilds=self._rebuilds[si])
+                continue  # budget permitting, a later event retries
+            old = self.sessions[si]
+            try:
+                # release the old claim and drop the old cache arrays;
+                # a still-wedged step finishing later republishes only
+                # the ORPHANED old names (the new namespace is why)
+                old.close()
+            except Exception:
+                pass
+            self.sessions[si] = new
+            self._wedged.pop(si, None)
+            self._trial_failures[si] = 0
+            if self._breakers is not None:
+                # fresh warmed session: straight back into rotation
+                self._breakers[si].record_success()
+            _REBUILDS.inc()
+            _log.structured("generation_session_rebuilt", session=si,
+                            seconds=round(secs, 3),
+                            rebuilds=self._rebuilds[si])
 
     def _update_occupancy(self):
         total = sum(s.spec.slots for s in self.sessions)
@@ -884,6 +1401,7 @@ class GenerationScheduler:
         free slots like live traffic."""
         while True:
             self._apply_pending_swap()
+            self._absorb_rebuilds()
             if self._active:
                 self._step_all()
                 continue
@@ -893,17 +1411,44 @@ class GenerationScheduler:
             if item is _STOP:
                 continue
             if not self._place(item) and not self._active:
+                if self._recovery_pending(item):
+                    # a rebuild hand-over or a breaker cooldown trial
+                    # will make room in finite time: the parked
+                    # request is served then, not failed now
+                    time.sleep(0.02)
+                    continue
                 # unplaceable with nothing in flight (external slot
                 # holders): resolve rather than spinning forever
                 parked = self._pending.popleft()
                 _resolve(parked.future,
-                         exception=ServingUnavailableError(
+                         exception=parked.last_exc
+                         if parked.last_exc is not None
+                         else ServingUnavailableError(
                              "scheduler stopped before the request "
                              "could be placed"))
 
+    def _dispatcher_exit(self):
+        """Dispatcher epilogue: nothing absorbs rebuilds past this
+        point, so mark terminal and release any stragglers (the
+        rebuild worker double-checks the flag around its put, closing
+        the hand-over race from its side). Health-gauge children
+        retire here too: this epilogue is the one point EVERY
+        shutdown shape reaches — including a drain() whose bounded
+        join expired and whose caller never calls close()."""
+        self._terminal = True
+        self._drain_rebuilt()
+        self._retire_breaker_gauges()
+
     def _loop(self):
+        try:
+            self._loop_inner()
+        finally:
+            self._dispatcher_exit()
+
+    def _loop_inner(self):
         while True:
             self._apply_pending_swap()
+            self._absorb_rebuilds()
             if self._active:
                 self._expire_queued()
                 got_stop = self._fill_slots()
@@ -912,6 +1457,11 @@ class GenerationScheduler:
                     self._serve_out()
                     return
             else:
+                # parked replay items may be waiting out a rebuild
+                # with nothing active — their deadlines must keep
+                # firing meanwhile (gated by _has_deadlines, so a
+                # deadline-free workload pays an attribute check)
+                self._expire_queued()
                 item = self._next_item(block=True)
                 if item is None:
                     if self._closed:
@@ -923,7 +1473,8 @@ class GenerationScheduler:
                 if not self._place(item):
                     # parked with nothing active: only possible while
                     # every fitting session's slots are held outside
-                    # this scheduler — back off instead of spinning
+                    # this scheduler or a rebuild is in flight — back
+                    # off instead of spinning
                     time.sleep(0.02)
 
     def _fill_slots(self):
@@ -984,6 +1535,7 @@ class GenerationScheduler:
         # the batching this layer exists for)
         self._pending.extend(leftovers)
         while self._pending or self._active:
+            self._absorb_rebuilds()
             progressed = False
             while self._pending:
                 if not self._place(self._pending.popleft()):
@@ -992,13 +1544,49 @@ class GenerationScheduler:
             if self._active:
                 self._step_all()
             elif not progressed and self._pending:
+                if self._recovery_pending(self._pending[0]):
+                    # a rebuild hand-over or cooldown trial serves
+                    # the parked items in finite time
+                    time.sleep(0.02)
+                    continue
                 # unplaceable with nothing in flight (external slot
                 # holders): resolve rather than spinning forever
                 parked = self._pending.popleft()
                 _resolve(parked.future,
-                         exception=ServingUnavailableError(
+                         exception=parked.last_exc
+                         if parked.last_exc is not None
+                         else ServingUnavailableError(
                              "drain: no session could take the "
                              "request"))
+        self._dispatcher_exit()  # retires the health gauges too
+
+    def _drain_rebuilt(self):
+        """Terminal sweep (close()/drain(), or the rebuild worker
+        itself when it races a close): completed rebuilds that no
+        dispatcher will ever absorb are released — their cache
+        claims and device arrays must not outlive the scheduler."""
+        while True:
+            try:
+                si, new, _err, _secs = self._rebuilt.get_nowait()
+            except queue.Empty:
+                return
+            self._rebuilding.discard(si)
+            if new is not None:
+                try:
+                    new.close()
+                except Exception:
+                    pass
+
+    def _retire_breaker_gauges(self):
+        """Drop this scheduler's per-session health-gauge children so
+        redeploy cycles don't accumulate stale labels on the shared
+        registry (the engine tier's close() discipline); ``retired``
+        keeps a straggling transition from resurrecting a child."""
+        if self._breakers is None:
+            return
+        for breaker in self._breakers:
+            breaker.retired = True
+            _sres.REPLICA_HEALTHY.remove(replica=breaker.label)
 
     def close(self, timeout=5.0):
         """Fast exit: a live dispatcher serves out everything it owns
@@ -1009,6 +1597,12 @@ class GenerationScheduler:
         for item in self._stop_dispatcher(timeout):
             _resolve(item.future,
                      exception=RuntimeError("scheduler closed"))
+        if self._thread is None:
+            # dispatcher gone (or never started): nothing absorbs
+            # rebuilds anymore; a live dispatcher past the bounded
+            # join runs the same epilogue itself when it exits
+            self._dispatcher_exit()
+        self._retire_breaker_gauges()
 
     def __enter__(self):
         return self.start()

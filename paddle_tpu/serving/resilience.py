@@ -34,7 +34,8 @@ from ..observability import metrics as _metrics
 from ..utils import log as _log
 
 __all__ = ["ServingDeadlineError", "ServingTimeoutError",
-           "ServingUnavailableError", "ReplicaBreaker", "BreakerProbe"]
+           "ServingUnavailableError", "ReplicaBreaker", "BreakerProbe",
+           "run_bounded"]
 
 DEADLINE_EXCEEDED = _metrics.REGISTRY.counter(
     "paddle_serving_deadline_exceeded_total",
@@ -67,6 +68,44 @@ class ServingTimeoutError(RuntimeError):
 
 class ServingUnavailableError(RuntimeError):
     """Every replica's breaker is open — nothing healthy to dispatch to."""
+
+
+def run_bounded(fn, timeout, name="serving-exec"):
+    """Run ``fn()`` on a daemon worker thread bounded by ``timeout``
+    seconds — the one structure that survives a wedged device call: a
+    hung execution can't be cancelled, so on timeout the worker is
+    left to finish (or hang forever) on its own thread and the caller
+    gets :class:`ServingTimeoutError` immediately. The error carries
+    the worker's done-``Event`` as ``.pending`` so the caller can cap
+    leaked threads to one per quarantined unit (engine replicas track
+    it as ``rep.stuck``, the generation dispatcher as a wedged-session
+    marker) instead of stacking a fresh blocked thread behind every
+    retry. Thread spawn cost is ~e-5 s against ms-scale executions
+    (measured within noise, PROFILE.md round 9).
+
+    On a non-timeout path the worker's return value is returned and
+    its exception re-raised unchanged."""
+    result = {}
+    done = threading.Event()
+
+    def work():
+        try:
+            result["value"] = fn()
+        except BaseException as exc:  # re-raised on the caller
+            result["exc"] = exc
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=work, daemon=True, name=name)
+    worker.start()
+    if not done.wait(timeout):
+        err = ServingTimeoutError(
+            "%s exceeded the %.3fs execution timeout" % (name, timeout))
+        err.pending = done
+        raise err
+    if "exc" in result:
+        raise result["exc"]
+    return result["value"]
 
 
 class ReplicaBreaker:

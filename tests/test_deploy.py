@@ -33,7 +33,8 @@ def _deploy_flags():
     """Every test starts with the deploy layer disarmed and leaves no
     armed faults or cache flag behind."""
     yield
-    ptpu.config.set_flags(compile_cache_dir=None)
+    ptpu.config.set_flags(compile_cache_dir=None,
+                          compile_cache_max_bytes=0)
     faults.disarm()
 
 
@@ -200,6 +201,73 @@ class TestPersistentCompileCache:
         self._run_once(np.zeros((8, 6), "float32"), cache_dir)
         bins = [f for f in os.listdir(cache_dir) if f.endswith(".bin")]
         assert len(bins) == 2
+
+
+class TestCompileCacheBound:
+    """compile_cache_max_bytes satellite: mtime-LRU eviction on the
+    store path. Serialization is stubbed to raw bytes so entry sizes
+    (and therefore eviction order) are exact and backend-independent;
+    load() runs the real verify/deserialize pipeline."""
+
+    def _prep(self, monkeypatch):
+        monkeypatch.setattr(cc, "serialize_compiled", lambda b: b)
+        monkeypatch.setattr(cc, "deserialize_compiled", lambda b: b)
+
+    def _digests(self, cache_dir):
+        return {f[len("entry_"):-len(".bin")]
+                for f in os.listdir(cache_dir) if f.endswith(".bin")}
+
+    def test_capped_dir_keeps_hottest_entries(self, tmp_path,
+                                              monkeypatch):
+        self._prep(monkeypatch)
+        cache_dir = str(tmp_path / "cc")
+        blob = b"x" * 1000
+        # cap ≈ two entries (blob + ~200-byte manifest each)
+        cache = cc.PersistentCompileCache(cache_dir, max_bytes=2600)
+        now = time.time()
+        for i, age in ((1, 300), (2, 200)):
+            assert cache.store("d%d" % i, blob)
+            for p in (cache._bin("d%d" % i), cache._meta("d%d" % i)):
+                os.utime(p, (now - age, now - age))
+        # a HIT touches d1's mtime: least-recently-USED is now d2
+        assert cache.load("d1") == blob
+        e0 = _counter("paddle_deploy_cache_evictions_total")
+        assert cache.store("d3", blob)
+        assert self._digests(cache_dir) == {"d1", "d3"}
+        assert _counter("paddle_deploy_cache_evictions_total") == e0 + 1
+        # manifests went with their blobs — no orphan halves
+        assert not os.path.exists(cache._meta("d2"))
+
+    def test_never_evicts_the_entry_just_published(self, tmp_path,
+                                                   monkeypatch):
+        """A cap smaller than one executable degrades to a cache of
+        one — it must not evict the entry it was asked to keep."""
+        self._prep(monkeypatch)
+        cache_dir = str(tmp_path / "cc")
+        cache = cc.PersistentCompileCache(cache_dir, max_bytes=10)
+        assert cache.store("a", b"y" * 500)
+        assert cache.store("b", b"y" * 500)
+        assert self._digests(cache_dir) == {"b"}
+        assert cache.load("b") == b"y" * 500
+
+    def test_unbounded_by_default(self, tmp_path, monkeypatch):
+        self._prep(monkeypatch)
+        assert ptpu.config.get_flag("compile_cache_max_bytes") == 0
+        cache_dir = str(tmp_path / "cc")
+        cache = cc.PersistentCompileCache(cache_dir)  # max_bytes=0
+        e0 = _counter("paddle_deploy_cache_evictions_total")
+        for i in range(4):
+            assert cache.store("u%d" % i, b"z" * 2000)
+        assert len(self._digests(cache_dir)) == 4
+        assert _counter("paddle_deploy_cache_evictions_total") == e0
+
+    def test_active_cache_refreshes_cap_from_flag(self, tmp_path):
+        cache_dir = str(tmp_path / "cc")
+        ptpu.config.set_flags(compile_cache_dir=cache_dir,
+                              compile_cache_max_bytes=12345)
+        assert cc.active_cache().max_bytes == 12345
+        ptpu.config.set_flags(compile_cache_max_bytes=0)
+        assert cc.active_cache().max_bytes == 0
 
 
 @pytest.mark.chaos

@@ -1,0 +1,490 @@
+"""Zero-client-error stateful generation: token-replay failover,
+session rebuild, the hang-free (step-timeout) dispatcher, and the
+default-off guarantees — plus the breaker-gauge namespace and the
+deadline-during-replay satellites."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+from paddle_tpu.models.transformer import (transformer_lm,
+                                           transformer_lm_session)
+from paddle_tpu.observability import metrics
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving import (GenerationScheduler, GenerationSession,
+                                ServingDeadlineError)
+from paddle_tpu.serving.resilience import REPLICA_HEALTHY
+
+pytestmark = pytest.mark.generation
+
+V, MAXLEN = 29, 12
+KW = dict(d_model=16, num_heads=2, d_ff=32, num_layers=2)
+BOS, EOS = 0, 1
+PROMPTS = ([BOS], [2, 3], [4, 5, 6], [BOS, 5])
+
+
+def _counter(name, **labels):
+    for s in metrics.REGISTRY.dump().get(name, {}).get("samples", ()):
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s["value"]
+    return 0.0
+
+
+def _lm_scope(seed=7):
+    """Randomized LM weights (prompt-dependent greedy sequences, the
+    test_generation.py discipline — an attractor token can't fake the
+    bit-identical assertions below)."""
+    with ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            toks = layers.data("toks", shape=[1, MAXLEN], dtype="int64",
+                               append_batch_size=False)
+            lbls = layers.data("lbls", shape=[1, MAXLEN], dtype="int64",
+                               append_batch_size=False)
+            transformer_lm(toks, lbls, vocab_size=V, is_test=True, **KW)
+    exe = ptpu.Executor()
+    scope = ptpu.Scope()
+    with ptpu.scope_guard(scope):
+        exe.run(startup)
+    rs = np.random.RandomState(seed)
+    for n in sorted(scope.var_names()):
+        cur = np.asarray(scope.find_var(n))
+        scope.set_var(n, rs.standard_normal(cur.shape)
+                      .astype(cur.dtype))
+    return scope
+
+
+def _session(scope, slots=2, warm=False, prompt_buckets=(4, 8, 12)):
+    spec = transformer_lm_session(V, max_len=MAXLEN, slots=slots,
+                                  cache_len=MAXLEN,
+                                  prompt_buckets=prompt_buckets,
+                                  bos_id=BOS, eos_id=EOS, **KW)
+    sess = GenerationSession(spec, scope=scope)
+    if warm:
+        # compile prefill+decode ahead of traffic, so a step timeout
+        # bounds real decode latency, not the first-step XLA compile
+        sess.generate([BOS], max_new_tokens=2, eos_id=-1)
+    return sess
+
+
+def _baseline(scope, prompts=PROMPTS, max_new=6):
+    """Fault-free scheduler run: the bit-identical oracle."""
+    sched = GenerationScheduler([_session(scope), _session(scope)])
+    try:
+        futs = [sched.submit(list(p), max_new_tokens=max_new, eos_id=-1)
+                for p in prompts]
+        return [[int(t) for t in f.result(timeout=60)] for f in futs]
+    finally:
+        sched.close()
+
+
+# -- token-replay failover -------------------------------------------------
+
+class TestReplayFailover:
+    def test_step_fault_zero_errors_bit_identical(self):
+        """Acceptance core: concurrent requests with session 0 killed
+        mid-decode all resolve successfully, token-for-token identical
+        to the fault-free run."""
+        scope = _lm_scope()
+        want = _baseline(scope)
+        f0 = _counter("paddle_generation_failover_total")
+        r0 = _counter("paddle_generation_replayed_tokens_total")
+        sched = GenerationScheduler(
+            [_session(scope), _session(scope)], replay_attempts=4,
+            breaker_failures=1, breaker_cooldown_ms=60000.0)
+        try:
+            faults.arm("generation_step_fail", at=0, times=1)
+            futs = [sched.submit(list(p), max_new_tokens=6, eos_id=-1)
+                    for p in PROMPTS]
+            got = [[int(t) for t in f.result(timeout=60)] for f in futs]
+            assert got == want
+            assert _counter("paddle_generation_failover_total") > f0
+            assert _counter("paddle_generation_replayed_tokens_total") \
+                > r0
+            # the failed session is quarantined, not resolving clients
+            assert sched.session_health()[0] == "open"
+        finally:
+            faults.disarm()
+            sched.close()
+
+    def test_admit_fault_replays_to_healthy_session(self):
+        scope = _lm_scope()
+        want = _baseline(scope, prompts=([BOS],), max_new=5)[0]
+        sched = GenerationScheduler(
+            [_session(scope), _session(scope)], replay_attempts=2,
+            breaker_failures=1, breaker_cooldown_ms=60000.0)
+        try:
+            faults.arm("generation_admit_fail", at=0, times=1)
+            got = [int(t) for t in
+                   sched.submit([BOS], max_new_tokens=5, eos_id=-1)
+                   .result(timeout=60)]
+            assert got == want
+        finally:
+            faults.disarm()
+            sched.close()
+
+    def test_replay_promotes_to_larger_prompt_bucket(self):
+        """A journal longer than the original prompt bucket re-admits
+        through a LARGER bucket: fail after 5 tokens on a 2-token
+        prompt -> the 7-token replay history needs bucket 8, not the
+        bucket-4 the original admission used. Driven synchronously on
+        the dispatcher's own entry points (autostart=False — the
+        single-threaded session contract) so the failure depth is
+        exact, not a race."""
+        scope = _lm_scope()
+        want = _baseline(scope, prompts=([2, 3],), max_new=9)[0]
+        sched = GenerationScheduler(
+            [_session(scope), _session(scope)], replay_attempts=2,
+            breaker_failures=1, breaker_cooldown_ms=60000.0,
+            autostart=False)
+        try:
+            fut = sched.submit([2, 3], max_new_tokens=9, eos_id=-1)
+            assert sched._place(sched._next_item(block=False))
+            for _ in range(4):
+                sched._step_all()       # 5 tokens generated
+            p0 = _counter("paddle_generation_prefills_total",
+                          bucket="8")
+            faults.arm("generation_step_fail", times=1)
+            sched._step_all()           # killed mid-decode -> replay
+            faults.disarm()
+            for _ in range(40):
+                if fut.done():
+                    break
+                item = sched._next_item(block=False)
+                if item is not None:
+                    sched._place(item)
+                sched._step_all()
+            got = [int(t) for t in fut.result(timeout=5)]
+            assert got == want
+            # the replay prefilled the 7-token journal through the
+            # larger bucket
+            assert _counter("paddle_generation_prefills_total",
+                            bucket="8") == p0 + 1
+        finally:
+            faults.disarm()
+            sched.close()
+
+    def test_replay_prefers_sessions_that_have_not_failed_it(self):
+        """A sub-threshold breaker stays closed after the
+        at-most-once charge, so placement alone can't steer the
+        replay away from the broken session — the request's own
+        failed_on memory must: with threshold 3 and a persistent
+        fault on session 0, the replay lands on session 1 instead of
+        burning the whole budget where it just failed."""
+        scope = _lm_scope()
+        want = _baseline(scope, prompts=([BOS],), max_new=4)[0]
+        sched = GenerationScheduler(
+            [_session(scope), _session(scope)], replay_attempts=3,
+            breaker_failures=3, breaker_cooldown_ms=60000.0)
+        try:
+            faults.arm("generation_step_fail", at=0, times=None)
+            got = [int(t) for t in
+                   sched.submit([BOS], max_new_tokens=4, eos_id=-1)
+                   .result(timeout=60)]
+            assert got == want
+            # session 0 charged once (sub-threshold, still closed) —
+            # the ROUTING saved the request, not the breaker
+            assert sched.session_health() == ["closed", "closed"]
+        finally:
+            faults.disarm()
+            sched.close()
+
+    def test_replay_budget_spent_surfaces_failure(self):
+        """A persistently-failing fleet cannot loop forever: once the
+        per-request replay budget is spent the original failure
+        surfaces (bounded, never a hang)."""
+        scope = _lm_scope()
+        sched = GenerationScheduler(
+            [_session(scope)], replay_attempts=2, breaker_failures=1,
+            breaker_cooldown_ms=10.0)
+        try:
+            faults.arm("generation_step_fail", at=0, times=None)
+            fut = sched.submit([BOS], max_new_tokens=5, eos_id=-1)
+            with pytest.raises(faults.InjectedFault):
+                fut.result(timeout=60)
+        finally:
+            faults.disarm()
+            sched.close()
+
+    def test_poison_request_charges_at_most_one_breaker(self):
+        """The PR-5/7 lesson carried to replay: a request whose own
+        admission keeps failing charges ONE breaker across all its
+        replays — it cannot quarantine the whole fleet."""
+        scope = _lm_scope()
+        want = _baseline(scope, prompts=([BOS],), max_new=4)[0]
+        sched = GenerationScheduler(
+            [_session(scope), _session(scope)], replay_attempts=3,
+            breaker_failures=1, breaker_cooldown_ms=60000.0)
+        try:
+            # fires on the first TWO admissions regardless of session:
+            # the "poison prompt" fails on session 0, replays onto
+            # session 1 and fails there too, then succeeds
+            faults.arm("generation_admit_fail", times=2)
+            got = [int(t) for t in
+                   sched.submit([BOS], max_new_tokens=4, eos_id=-1)
+                   .result(timeout=60)]
+            assert got == want
+            # session 0 (first failure) charged and open; session 1's
+            # failure was the same request's second strike — uncharged
+            assert sched.session_health() == ["open", "closed"]
+        finally:
+            faults.disarm()
+            sched.close()
+
+    def test_poison_step_charges_at_most_one_breaker(self):
+        """Same discipline on the STEP path: a request whose decode
+        step fails wherever it lands charges only the first session's
+        breaker — replaying it across the fleet opens one breaker,
+        not all of them."""
+        scope = _lm_scope()
+        want = _baseline(scope, prompts=([BOS],), max_new=4)[0]
+        sched = GenerationScheduler(
+            [_session(scope), _session(scope)], replay_attempts=3,
+            breaker_failures=1, breaker_cooldown_ms=60000.0)
+        try:
+            # fires on the first TWO steps regardless of session: the
+            # lone request fails on session 0 (charged), replays onto
+            # session 1 and fails there too (all affected requests
+            # already charged -> no charge), then completes
+            faults.arm("generation_step_fail", times=2)
+            got = [int(t) for t in
+                   sched.submit([BOS], max_new_tokens=4, eos_id=-1)
+                   .result(timeout=60)]
+            assert got == want
+            assert sched.session_health() == ["open", "closed"]
+        finally:
+            faults.disarm()
+            sched.close()
+
+
+# -- hang-free dispatcher --------------------------------------------------
+
+class TestStepTimeout:
+    def test_wedged_step_replays_and_quarantines(self):
+        """A session wedged past generation_step_timeout_ms is a
+        failure, not a freeze: its requests replay elsewhere with
+        identical tokens, the other session keeps decoding, the
+        breaker opens instantly (hang rule), and the stuck worker is
+        leaked-and-capped at one."""
+        scope = _lm_scope()
+        want = _baseline(scope)
+        t0 = _counter("paddle_generation_step_timeouts_total")
+        sched = GenerationScheduler(
+            [_session(scope, warm=True), _session(scope, warm=True)],
+            replay_attempts=4, breaker_failures=3,
+            breaker_cooldown_ms=60000.0, step_timeout_ms=500.0)
+        try:
+            faults.arm("generation_session_wedge", at=0, times=1,
+                       action="callback",
+                       callback=lambda: time.sleep(2.0))
+            futs = [sched.submit(list(p), max_new_tokens=6, eos_id=-1)
+                    for p in PROMPTS]
+            got = [[int(t) for t in f.result(timeout=60)] for f in futs]
+            assert got == want
+            assert _counter("paddle_generation_step_timeouts_total") \
+                == t0 + 1
+            # one hang = open immediately, threshold 3 notwithstanding
+            assert sched.session_health()[0] == "open"
+            time.sleep(0.1)  # let finished per-step workers tear down
+            leaked = [t for t in threading.enumerate()
+                      if t.name.startswith("generation-step-")]
+            assert len(leaked) <= 1
+        finally:
+            faults.disarm()
+            sched.close()
+
+
+# -- session rebuild -------------------------------------------------------
+
+class TestSessionRebuild:
+    def test_quarantined_session_rebuilt_and_serves(self):
+        """A session whose post-quarantine trials keep failing is torn
+        down and reconstructed (fresh cache namespace) in the
+        background; once the fault clears the rebuilt session serves —
+        zero client errors throughout, tokens identical."""
+        scope = _lm_scope()
+        sched0 = GenerationScheduler([_session(scope)])
+        want = [int(t) for t in
+                sched0.submit([BOS], max_new_tokens=5, eos_id=-1)
+                .result(timeout=60)]
+        sched0.close()
+
+        sess = _session(scope)
+        old_ns = {n for n, _, _ in sess.spec.cache_vars}
+        b0 = _counter("paddle_generation_session_rebuilds_total")
+        sched = GenerationScheduler(
+            [sess], replay_attempts=10, breaker_failures=1,
+            breaker_cooldown_ms=30.0, rebuild_limit=2)
+        try:
+            # 3 firings: the initial failure plus two failed cooldown
+            # trials — the rebuild trigger — after which the "device"
+            # heals and the rebuilt session completes the request
+            faults.arm("generation_step_fail", at=0, times=3)
+            got = [int(t) for t in
+                   sched.submit([BOS], max_new_tokens=5, eos_id=-1)
+                   .result(timeout=60)]
+            assert got == want
+            assert _counter("paddle_generation_session_rebuilds_total") \
+                == b0 + 1
+            new_ns = {n for n, _, _ in sched.sessions[0].spec.cache_vars}
+            assert new_ns != old_ns  # fresh namespace, not a reuse
+            assert sched.session_health() == ["closed"]
+        finally:
+            faults.disarm()
+            sched.close()
+
+    def test_rebuild_budget_bounded(self):
+        """rebuild_limit bounds reconstruction attempts per session —
+        a session broken beyond its budget stays out."""
+        scope = _lm_scope()
+        sess = _session(scope)
+        sched = GenerationScheduler([sess], autostart=False,
+                                    replay_attempts=1,
+                                    breaker_failures=1,
+                                    rebuild_limit=1)
+        try:
+            sched._rebuilds[0] = 1          # budget already spent
+            sched._trial_failures[0] = 99   # however broken it looks
+            sched._maybe_rebuild(0)
+            assert not sched._rebuilding
+            assert sched._rebuilds[0] == 1
+        finally:
+            sched.close()
+
+
+# -- deadline during replay (satellite) ------------------------------------
+
+class TestDeadlineDuringReplay:
+    def test_expires_parked_without_reprefill(self):
+        """A request whose deadline runs out while parked for replay
+        resolves with ServingDeadlineError WITHOUT re-prefilling, and
+        requests_total is unchanged — the PR-5 'expired never touches
+        a device' invariant extended to the retry path."""
+        scope = _lm_scope()
+        # session 1's only slot is pinned by a long generation, so the
+        # replayed request has nowhere to go and must park
+        sched = GenerationScheduler(
+            [_session(scope, slots=1), _session(scope, slots=1)],
+            replay_attempts=4, breaker_failures=1,
+            breaker_cooldown_ms=60000.0)
+        try:
+            r_start = _counter("paddle_generation_requests_total")
+            long_fut = sched.submit([BOS], max_new_tokens=11, eos_id=-1)
+            victim = sched.submit([2, 3], max_new_tokens=8, eos_id=-1,
+                                  deadline_ms=400.0)
+            # wait until both are placed (victim on session 1), then
+            # kill session 1 persistently: the victim replays, parks
+            # behind the busy session 0, and its deadline expires there
+            deadline = time.monotonic() + 30
+            while _counter("paddle_generation_requests_total") \
+                    < r_start + 2 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            req0 = _counter("paddle_generation_requests_total")
+            faults.arm("generation_step_fail", at=1, times=None)
+            t0 = time.perf_counter()
+            with pytest.raises(ServingDeadlineError):
+                victim.result(timeout=30)
+            # resolved near its budget, not after the long generation
+            assert time.perf_counter() - t0 < 5.0
+            assert _counter("paddle_generation_requests_total") == req0
+            faults.disarm()
+            assert len(long_fut.result(timeout=60)) == 11
+        finally:
+            faults.disarm()
+            sched.close()
+
+
+# -- breaker-gauge namespace (satellite) -----------------------------------
+
+class TestGaugeNamespace:
+    def test_session_gauges_namespaced_and_retired(self):
+        """Per-session health gauges are namespaced g<N>:<session>
+        (the PR-7 engine discipline, 'e<N>:<replica>'), so a process
+        running both tiers never overwrites one with the other; close
+        drops the children so redeploy cycles don't accumulate."""
+        scope = _lm_scope()
+        sched_a = GenerationScheduler([_session(scope)], autostart=False,
+                                      breaker_failures=1)
+        sched_b = GenerationScheduler([_session(scope)], autostart=False,
+                                      breaker_failures=1)
+        label_a = "g%d:0" % sched_a._sched_id
+        label_b = "g%d:0" % sched_b._sched_id
+        labels = {c.labels_dict["replica"]
+                  for c in REPLICA_HEALTHY.children().values()}
+        assert label_a in labels and label_b in labels
+        assert label_a != label_b
+        # the engine tier's namespace is disjoint by prefix
+        assert not any(lb.startswith("e") for lb in (label_a, label_b))
+        sched_a.close()
+        sched_b.close()
+        labels = {c.labels_dict["replica"]
+                  for c in REPLICA_HEALTHY.children().values()}
+        assert label_a not in labels and label_b not in labels
+
+
+# -- default-off guarantees ------------------------------------------------
+
+class TestDefaultOff:
+    def test_flags_exist_with_defaults(self):
+        assert ptpu.config.get_flag("generation_replay_attempts") == 0
+        assert ptpu.config.get_flag("generation_rebuild_limit") == 0
+        assert ptpu.config.get_flag("generation_step_timeout_ms") == 0
+        assert ptpu.config.get_flag("compile_cache_max_bytes") == 0
+
+    def test_dispatcher_hot_path_reads_no_flags(self, monkeypatch):
+        """Acceptance: with the flags at defaults the dispatcher loop
+        is the pre-recovery hot path — config is read only at
+        construction (flag-check count asserted across a full
+        submit->result generation), no replay machinery, no step
+        worker threads."""
+        scope = _lm_scope()
+        # warmed: the measured window covers dispatch only, not the
+        # first-compile trace (which legitimately reads trace-time
+        # flags like amp/flash_attention)
+        sess = _session(scope, warm=True)
+        sched = GenerationScheduler(sess)
+        try:
+            assert sched.replay_attempts == 0
+            assert sched.rebuild_limit == 0
+            assert sched.step_timeout is None
+            calls = []
+            orig = ptpu.config.get_flag
+
+            def counting(name):
+                calls.append(name)
+                return orig(name)
+
+            monkeypatch.setattr(ptpu.config, "get_flag", counting)
+            got = sched.submit([BOS], max_new_tokens=4,
+                               eos_id=-1).result(timeout=60)
+            assert len(got) == 4
+            # the recovery flags are construction-only reads: the
+            # per-tick reads are exactly the pre-recovery set (the
+            # executor's trace-time cache-key flags plus the
+            # fault_injection master switch in fire_point)
+            assert not [c for c in calls
+                        if c.startswith(("generation_",
+                                         "compile_cache_max"))]
+            workers = [t for t in threading.enumerate()
+                       if t.name.startswith("generation-step-")]
+            assert not workers
+        finally:
+            sched.close()
+
+    def test_default_step_failure_still_resolves_exceptionally(self):
+        """Replay off = the pre-replay contract: a step failure
+        resolves the session's requests with the failure itself."""
+        scope = _lm_scope()
+        sched = GenerationScheduler(_session(scope))
+        try:
+            faults.arm("generation_step_fail", at=0, times=1)
+            fut = sched.submit([BOS], max_new_tokens=5, eos_id=-1)
+            with pytest.raises(faults.InjectedFault):
+                fut.result(timeout=30)
+        finally:
+            faults.disarm()
+            sched.close()

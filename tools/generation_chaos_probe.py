@@ -1,0 +1,247 @@
+"""Stateful-generation chaos probe: zero-client-error failover under a
+persistently broken session AND an injected wedge, headless.
+
+The generation counterpart of ``tools/serving_chaos_probe.py``: a
+randomized transformer LM served through a 2-session
+``GenerationScheduler`` with the whole recovery stack armed —
+token-replay failover, session rebuild, and the step-timeout
+dispatcher — while TWO fault sites are hot:
+
+* ``generation_step_fail`` at session 0, **persistent** (``times=None``
+  — the session is broken, not glitching): every request that lands
+  there replays onto session 1, the breaker quarantines it, failed
+  cooldown trials trigger a background rebuild (fresh cache
+  namespace), and — the fault being persistent — the rebuilt session
+  fails again until the injection lifts after the client run;
+* ``generation_session_wedge`` at session 1, once: a decode step hangs
+  past ``step_timeout_ms``; the dispatcher times it out on its worker
+  thread (leaked-and-capped), replays its requests, and the session
+  re-enters through a cooldown trial once the wedge clears.
+
+Proves, with no accelerator and no test harness:
+
+* zero client-visible errors: every request completes, and every
+  completed sequence is TOKEN-IDENTICAL to the fault-free baseline run
+  (greedy replay determinism — the tentpole claim);
+* the recovery counters (failover / replayed tokens / rebuilds / step
+  timeouts) and the fault-to-resumed-decode latency expose all of it.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/generation_chaos_probe.py
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+VOCAB = 64
+KW = dict(d_model=64, num_heads=2, d_ff=128, num_layers=2)
+BOS, EOS = 0, 1
+N_REQUESTS = 12
+MAX_NEW = 20
+MAX_LEN = 48          # covers prompt + MAX_NEW, so any replay history
+PROMPT_BUCKETS = (8, 16, 32)  # ... always fits a (possibly larger) bucket
+SLOTS = 4
+STEP_TIMEOUT_MS = 1500.0
+WEDGE_SEC = 3.0
+
+
+def build_scope():
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+    from paddle_tpu.models.transformer import transformer_lm
+
+    with ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            toks = layers.data("toks", shape=[1, MAX_LEN], dtype="int64",
+                               append_batch_size=False)
+            lbls = layers.data("lbls", shape=[1, MAX_LEN], dtype="int64",
+                               append_batch_size=False)
+            transformer_lm(toks, lbls, vocab_size=VOCAB, is_test=True,
+                           **KW)
+    exe = ptpu.Executor()
+    scope = ptpu.Scope()
+    with ptpu.scope_guard(scope):
+        exe.run(startup)
+    rs = np.random.RandomState(7)
+    for n in sorted(scope.var_names()):
+        cur = np.asarray(scope.find_var(n))
+        scope.set_var(n, rs.standard_normal(cur.shape).astype(cur.dtype))
+    return scope
+
+
+def make_session(scope, warm=True):
+    from paddle_tpu.models.transformer import transformer_lm_session
+    from paddle_tpu.serving.generation import GenerationSession
+
+    spec = transformer_lm_session(
+        VOCAB, max_len=MAX_LEN, slots=SLOTS, cache_len=MAX_LEN,
+        prompt_buckets=PROMPT_BUCKETS, bos_id=BOS, eos_id=EOS, **KW)
+    sess = GenerationSession(spec, scope=scope)
+    if warm:
+        # compile prefill+decode ahead of the armed step timeout: the
+        # timeout bounds decode latency, not a first-step XLA compile
+        sess.generate([BOS], max_new_tokens=2, eos_id=-1)
+    return sess
+
+
+def hist_stats(name):
+    from paddle_tpu.observability import metrics
+    for s in metrics.REGISTRY.dump().get(name, {}).get("samples", ()):
+        if s["count"]:
+            return s
+    return None
+
+
+def hist_pct(sample, p):
+    """Prometheus-style percentile estimate off cumulative buckets
+    (upper bound of the bucket the quantile lands in, in ms)."""
+    if not sample:
+        return 0.0
+    want = sample["count"] * p / 100.0
+    for ub, cum in sorted(sample["buckets"].items(),
+                          key=lambda kv: float(kv[0])):
+        if cum >= want:
+            return float(ub) * 1e3
+    return float(sample["max"]) * 1e3
+
+
+def main():
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving.generation import GenerationScheduler
+
+    scope = build_scope()
+    rs = np.random.RandomState(0)
+    prompts = [[BOS] + list(rs.randint(2, VOCAB,
+                                       int(rs.randint(0, 6))))
+               for _ in range(N_REQUESTS)]
+
+    print("== baseline: fault-free run (the bit-identical oracle) ==")
+    sched = GenerationScheduler([make_session(scope, warm=False),
+                                 make_session(scope, warm=False)])
+    futs = [sched.submit(p, max_new_tokens=MAX_NEW, eos_id=-1)
+            for p in prompts]
+    baseline = [[int(t) for t in f.result(timeout=300)] for f in futs]
+    sched.close()
+    print(json.dumps({"requests": len(baseline),
+                      "tokens": sum(map(len, baseline))}))
+
+    print("== chaos: persistent step-fault on session 0 + one wedge "
+          "on session 1 ==")
+    sched = GenerationScheduler(
+        [make_session(scope), make_session(scope)],
+        replay_attempts=8, breaker_failures=1,
+        breaker_cooldown_ms=100.0, rebuild_limit=2,
+        step_timeout_ms=STEP_TIMEOUT_MS)
+    faults.arm("generation_step_fail", at=0, times=None)  # persistent
+    faults.arm("generation_session_wedge", at=1, times=1,
+               action="callback",
+               callback=lambda: time.sleep(WEDGE_SEC))
+
+    t0 = time.perf_counter()
+    futs = [sched.submit(p, max_new_tokens=MAX_NEW, eos_id=-1)
+            for p in prompts]
+    results, errors = [], []
+    for i, f in enumerate(futs):
+        try:
+            results.append([int(t) for t in f.result(timeout=300)])
+        except Exception as exc:
+            results.append(None)
+            errors.append("req %d: %r" % (i, exc))
+    wall = time.perf_counter() - t0
+
+    health_under_fault = sched.session_health()
+    faults.disarm("generation_step_fail")
+    # the (possibly rebuilt) session 0 re-enters through a cooldown
+    # trial once the injection lifts
+    deadline = time.monotonic() + 15
+    fut = sched.submit(prompts[0], max_new_tokens=4, eos_id=-1)
+    fut.result(timeout=60)
+    while sched.session_health() != ["closed", "closed"] and \
+            time.monotonic() < deadline:
+        fut = sched.submit(prompts[0], max_new_tokens=2, eos_id=-1)
+        fut.result(timeout=60)
+        time.sleep(0.05)
+    readmitted = sched.session_health() == ["closed", "closed"]
+    faults.disarm()
+    sched.drain()
+
+    mismatches = [i for i, (got, want) in enumerate(zip(results,
+                                                        baseline))
+                  if got is not None and got != want]
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("generation-step-")]
+
+    # -- report ----------------------------------------------------------
+    dump = metrics.REGISTRY.dump()
+
+    def counter(name):
+        for s in dump.get(name, {}).get("samples", ()):
+            return s["value"]
+        return 0.0
+
+    recov = hist_stats("paddle_generation_failover_recovery_seconds")
+    print("== generation chaos report " + "=" * 39)
+    print(json.dumps({
+        "requests": N_REQUESTS,
+        "completed": sum(1 for r in results if r is not None),
+        "client_errors": errors,
+        "token_mismatches_vs_fault_free": mismatches,
+        "health_under_fault": health_under_fault,
+        "session0_readmitted_after_disarm": readmitted,
+        "wall_sec": round(wall, 2),
+        "leaked_step_workers": leaked,
+        "recovery_ms": {
+            "count": recov["count"] if recov else 0,
+            "mean": round(recov["sum"] / recov["count"] * 1e3, 2)
+            if recov else None,
+            "p50_le": round(hist_pct(recov, 50), 1),
+            "p95_le": round(hist_pct(recov, 95), 1),
+            "max": round(recov["max"] * 1e3, 2) if recov else None,
+        },
+    }, indent=1))
+    print("== recovery counters " + "=" * 45)
+    for line in metrics.REGISTRY.expose_text().splitlines():
+        if line.startswith(("paddle_generation_failover",
+                            "paddle_generation_replayed",
+                            "paddle_generation_session_rebuilds",
+                            "paddle_generation_step_timeouts",
+                            "paddle_serving_breaker",
+                            "paddle_serving_replica_healthy")):
+            print(line)
+
+    # -- smoke assertions (exit non-zero if the layer is broken) ---------
+    assert not errors, errors
+    assert not mismatches, mismatches
+    assert counter("paddle_generation_failover_total") > 0
+    assert counter("paddle_generation_replayed_tokens_total") > 0
+    assert counter("paddle_generation_step_timeouts_total") >= 1
+    assert counter("paddle_generation_session_rebuilds_total") >= 1, \
+        "no rebuild: session 0's failed trials never triggered one"
+    assert health_under_fault[0] in ("open", "half_open"), \
+        health_under_fault
+    assert readmitted, "session 0 never re-admitted after disarm"
+    assert len(leaked) <= 1, leaked
+    print("GENERATION CHAOS PROBE OK: %d/%d served bit-identical, "
+          "failover=%d, replayed_tokens=%d, rebuilds=%d, "
+          "step_timeouts=%d, recovery p50<=%.0f ms"
+          % (N_REQUESTS, N_REQUESTS,
+             counter("paddle_generation_failover_total"),
+             counter("paddle_generation_replayed_tokens_total"),
+             counter("paddle_generation_session_rebuilds_total"),
+             counter("paddle_generation_step_timeouts_total"),
+             hist_pct(recov, 50)))
+
+
+if __name__ == "__main__":
+    main()
