@@ -748,6 +748,92 @@ def bench_generation(on_accel):
     }]
 
 
+def bench_paged_kv(on_accel):
+    """Paged KV cache + prefix reuse (ISSUE 11), under the regression
+    tripwire:
+
+    * ``kv_cache_bytes_per_token`` — HBM pinned per LIVE token at
+      steady state on a shared-prefix workload (pool blocks in use x
+      block bytes / live tokens). Lower is better; the dense layout's
+      equivalent (slots x worst-case rows) rides along as context.
+    * ``prefix_cache_hit_rate`` — prompt tokens served from cached
+      prefix blocks / total prompt tokens submitted. Higher is
+      better; on the shared-system-prompt workload the common prefix
+      should prefill exactly once."""
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+    from paddle_tpu.models.transformer import (transformer_lm_generate,
+                                               transformer_lm_session)
+    from paddle_tpu.serving.generation import GenerationSession
+
+    kw = dict(d_model=512, num_heads=8, d_ff=2048, num_layers=4) \
+        if on_accel else dict(d_model=64, num_heads=2, d_ff=128,
+                              num_layers=2)
+    vocab = 1024 if on_accel else 64
+    suffix = "" if on_accel else "_cpu_smoke"
+    slots, cache_len, block_size = 8, 64, 8
+    max_len = cache_len
+
+    with ptpu.unique_name.guard():
+        main_prog, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main_prog, startup):
+            anchor = layers.data("anchor", shape=[1], dtype="int32")
+            transformer_lm_generate(anchor, vocab_size=vocab,
+                                    max_len=max_len, beam_size=1, **kw)
+    exe = ptpu.Executor()
+    exe.run(startup)
+
+    spec = transformer_lm_session(
+        vocab, max_len=max_len, slots=slots, cache_len=cache_len,
+        prompt_buckets=(8, 16), paged=True, block_size=block_size,
+        prefix_cache=True, **kw)
+    sess = GenerationSession(spec)
+    rs = np.random.RandomState(0)
+    system = list(rs.randint(2, vocab, 14))   # shared system prompt
+    # one full pass warms every compile outside the measured window
+    sess.generate(system + [2], max_new_tokens=4, eos_id=-1)
+
+    live_slots = []
+    prompt_tokens = 0
+    for i in range(slots):
+        prompt = system + [3 + i]
+        prompt_tokens += len(prompt)
+        live_slots.append(sess.admit(prompt)[0])
+    for _ in range(8):
+        sess.step()
+    live_tokens = int(sess.lengths[live_slots].sum())
+    pstats = sess.pool_stats()
+    paged_bytes = pstats["blocks_in_use"] * pstats["bytes_per_block"]
+    row_bytes = pstats["bytes_per_block"] / block_size
+    dense_bytes = slots * cache_len * row_bytes
+    xstats = sess.prefix_stats()
+    hit_rate = xstats["shared_tokens"] / float(prompt_tokens)
+    for s in live_slots:
+        sess.retire(s)
+    sess.check_pool_invariant()
+    sess.close()
+
+    return [{
+        "metric": "kv_cache_bytes_per_token" + suffix,
+        "value": round(paged_bytes / live_tokens, 1),
+        "unit": "cache bytes pinned per live token (paged pool, "
+                "shared-prefix workload)",
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        "dense_equiv_bytes_per_token": round(
+            dense_bytes / live_tokens, 1),
+        "pool_blocks_in_use": pstats["blocks_in_use"],
+        "block_size": block_size,
+    }, {
+        "metric": "prefix_cache_hit_rate" + suffix,
+        "value": round(hit_rate, 3),
+        "unit": "shared prompt tokens / submitted prompt tokens",
+        "vs_baseline": 1.0,
+        "shared_tokens": xstats["shared_tokens"],
+        "prompt_tokens": prompt_tokens,
+    }]
+
+
 def bench_generation_failover(on_accel):
     """Fault-to-resumed-decode latency of token-replay failover
     (ISSUE 10): a mid-decode session kill re-queues the request and
@@ -950,6 +1036,8 @@ def main():
              lambda: bench_deploy(on_accel)),
             ("decode_tokens_per_sec",
              lambda: bench_generation(on_accel)),
+            ("kv_cache_bytes_per_token",
+             lambda: bench_paged_kv(on_accel)),
             ("generation_failover_recovery_ms",
              lambda: bench_generation_failover(on_accel))]:
         try:

@@ -168,6 +168,31 @@ generation_step_timeout_ms: per-session decode-step timeout for the
   step() can no longer freeze every other session and the deadline
   sweeps. Read only at scheduler construction.
 
+generation_paged_kv / generation_block_size / generation_pool_blocks /
+generation_prefix_cache: paged-KV-cache defaults for
+  ``transformer_lm_session`` (models/transformer.py +
+  serving/paged_cache.py). With ``generation_paged_kv`` False (the
+  default) a session owns dense per-slot [slots, cache_len, d_model]
+  K/V buffers — the PR-8/9 layout, byte-identical behavior. True
+  rebuilds per-layer K/V storage as ONE [num_blocks, block_size,
+  d_model] block pool: each sequence owns a host-side block table,
+  cache writes become block-granular in-place updates through the
+  table (same donation contract), and HBM pinned per sequence is
+  proportional to its LIVE length instead of the worst-case bucket —
+  concurrency becomes "pool bytes / live tokens", not "slots x
+  worst-case bucket". ``generation_block_size`` is the rows-per-block
+  granularity (small = less fragmentation waste per sequence, large =
+  fewer gather indices and better prefix-sharing amortization);
+  ``generation_pool_blocks`` sizes the pool (0 = auto: byte parity
+  with the dense layout, slots x ceil(cache_len/block_size) blocks);
+  ``generation_prefix_cache`` additionally content-hashes prefill
+  blocks at block granularity and shares full blocks read-only across
+  sequences via refcounts (copy-on-write when a sequence writes into
+  a shared block), so a shared system prompt prefills ONCE and a
+  PR-9 token replay re-prefills only its unshared suffix. All read
+  only at session construction — generation unused costs zero flag
+  checks anywhere, and the dense decode path consults none of them.
+
 compile_cache_max_bytes: 0 (default) = the persistent compile cache
   dir grows without bound (the pre-cap behavior). When set, store()
   evicts coldest-mtime entries (bin+manifest together; load() hits
@@ -221,6 +246,13 @@ _flags = {
     "generation_replay_attempts": 0,
     "generation_rebuild_limit": 0,
     "generation_step_timeout_ms": 0,
+    # paged KV cache + prefix reuse (serving/paged_cache.py; read only
+    # at session construction — defaults keep the dense PR-8/9 cache
+    # layout byte-identical)
+    "generation_paged_kv": False,
+    "generation_block_size": 16,
+    "generation_pool_blocks": 0,
+    "generation_prefix_cache": False,
     # persistent compile cache size cap (core/compile_cache.py)
     "compile_cache_max_bytes": 0,
 }

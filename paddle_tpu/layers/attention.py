@@ -68,6 +68,16 @@ def multi_head_attention_cached(x, cache, d_model, num_heads,
       query attends cache rows [0, pos] per slot (its own row
       included).
 
+    With ``cache["layout"] == "paged"`` the k/v Variables are
+    [num_blocks, block_size, d_model] block POOLS and the ops route
+    through a block table (``cache["table"]``; ops/generation_ops.py
+    paged variants): prefill becomes a suffix-window prefill — x is
+    the UNSHARED tail of the prompt, ``cache["hist"]`` rows are
+    already cached (shared prefix blocks) and the window attends the
+    cached prefix plus itself causally — and decode gathers each
+    slot's K/V through its table row. Same masking/softmax contracts
+    as the dense layout; token parity is a test invariant.
+
     Because the q/k/v/o parameter names match the uncached layer
     (same ``unique_name`` sequence), programs built under the same
     ``unique_name.guard()`` discipline share weights through the scope
@@ -88,6 +98,49 @@ def multi_head_attention_cached(x, cache, d_model, num_heads,
                param_attr=attr("qkv_v"), **kwargs)
     ck, cv = cache["k"], cache["v"]
     ctx_out = helper.create_tmp_variable(x.dtype)
+    if cache.get("layout") == "paged":
+        table = cache["table"]
+        if cache["mode"] == "prefill":
+            hist = cache["hist"]
+            # window rows land at positions [hist, hist+key_length)
+            # through the block table; padding rows drop
+            for cvar, proj in ((ck, k), (cv, v)):
+                helper.append_op(type="kv_cache_write_paged",
+                                 inputs={"Cache": [cvar.name],
+                                         "New": [proj.name],
+                                         "Table": [table.name],
+                                         "Hist": [hist.name],
+                                         "Len": [key_length.name]},
+                                 outputs={"Out": [cvar.name]})
+            helper.append_op(type="multihead_attention_prefill_paged",
+                             inputs={"Q": [q.name], "CacheK": [ck.name],
+                                     "CacheV": [cv.name],
+                                     "Table": [table.name],
+                                     "Hist": [hist.name],
+                                     "Len": [key_length.name]},
+                             outputs={"Out": [ctx_out.name]},
+                             attrs={"num_heads": num_heads})
+        elif cache["mode"] == "decode":
+            pos = cache["pos"]
+            for cvar, proj in ((ck, k), (cv, v)):
+                helper.append_op(type="kv_cache_append_paged",
+                                 inputs={"Cache": [cvar.name],
+                                         "New": [proj.name],
+                                         "Pos": [pos.name],
+                                         "Table": [table.name]},
+                                 outputs={"Out": [cvar.name]})
+            helper.append_op(type="multihead_attention_decode_paged",
+                             inputs={"Q": [q.name], "CacheK": [ck.name],
+                                     "CacheV": [cv.name],
+                                     "Pos": [pos.name],
+                                     "Table": [table.name]},
+                             outputs={"Out": [ctx_out.name]},
+                             attrs={"num_heads": num_heads})
+        else:
+            raise ValueError("cache mode must be 'prefill' or "
+                             "'decode', got %r" % (cache["mode"],))
+        return _nn.fc(ctx_out, d_model, num_flatten_dims=2,
+                      bias_attr=False, param_attr=attr("o"), **kwargs)
     if cache["mode"] == "prefill":
         slot = cache["slot"]
         # cache writes alias the cache variable name: the executor
@@ -185,8 +238,8 @@ def positional_encoding(x, max_len=None, name=None, **kwargs):
     return out
 
 
-def positional_encoding_window(x, max_len, pos=None, name=None,
-                               **kwargs):
+def positional_encoding_window(x, max_len, pos=None, window_rows=False,
+                               name=None, **kwargs):
     """A window of the SAME learned position table as
     :func:`positional_encoding` (identical parameter name when built
     under the same ``unique_name`` sequence, so a full-sequence train
@@ -196,7 +249,12 @@ def positional_encoding_window(x, max_len, pos=None, name=None,
       table are added to x [1, P, D].
     * ``pos`` given (decode): row ``pos[s]`` is gathered per slot and
       added to x [S, 1, D] — one position embedding per in-flight
-      sequence, each at its own depth."""
+      sequence, each at its own depth.
+    * ``pos`` given with ``window_rows=True`` (paged suffix prefill):
+      ``pos`` is one index PER WINDOW ROW ([P], typically
+      hist + arange(P)) and the gathered rows are added along x's
+      time axis [1, P, D] — a prompt window starting at an arbitrary
+      cached depth."""
     helper = LayerHelper("pos_encoding", name=name, **kwargs)
     d = x.shape[2]
     table = helper.create_parameter(
@@ -222,9 +280,12 @@ def positional_encoding_window(x, max_len, pos=None, name=None,
                          inputs={"X": [table.name], "Index": [pos.name]},
                          outputs={"Out": [rows.name]})
         rows3 = helper.create_tmp_variable(x.dtype)
+        # window mode: rows line up with x's TIME axis [1, P, D];
+        # decode mode: one row per slot along the batch axis [S, 1, D]
+        shape3 = [1, -1, d] if window_rows else [-1, 1, d]
         helper.append_op(type="reshape", inputs={"X": [rows.name]},
                          outputs={"Out": [rows3.name]},
-                         attrs={"shape": [-1, 1, d]})
+                         attrs={"shape": shape3})
         helper.append_op(type="elementwise_add",
                          inputs={"X": [x.name], "Y": [rows3.name]},
                          outputs={"Out": [out.name]}, attrs={})

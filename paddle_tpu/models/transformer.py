@@ -26,14 +26,23 @@ def _lm_backbone(tokens, vocab_size, d_model, num_heads, d_ff, num_layers,
     pairs per layer]), ``max_len`` (position-table length — must equal
     the table length of the program whose weights are served), and the
     mode's index feeds (``slot``/``key_length`` for prefill,
-    ``pos``/``length`` for decode). Every parameter name is identical
-    to the uncached build — cached programs serve a scope trained by
-    the plain ones."""
+    ``pos``/``length`` for decode). With ``layout='paged'`` the caches
+    are block pools and the dict carries ``table`` (block-table feed)
+    plus, for prefill, ``hist`` (cached-prefix depth) and ``pos_idx``
+    (per-window-row position indices, hist + arange(P)). Every
+    parameter name is identical to the uncached build — cached
+    programs serve a scope trained by the plain ones."""
     emb = layers.embedding(tokens, size=[vocab_size, d_model],
                            param_attr="tok_embedding",
                            keep_dims=cache_ctx is not None)
     if cache_ctx is None:
         x = positional_encoding(emb)
+    elif cache_ctx.get("pos_idx") is not None:
+        # paged suffix prefill: the window starts at cached depth
+        # hist, so its position rows are gathered, not sliced from 0
+        x = positional_encoding_window(emb, cache_ctx["max_len"],
+                                       pos=cache_ctx["pos_idx"],
+                                       window_rows=True)
     else:
         x = positional_encoding_window(emb, cache_ctx["max_len"],
                                        pos=cache_ctx.get("pos"))
@@ -44,7 +53,10 @@ def _lm_backbone(tokens, vocab_size, d_model, num_heads, d_ff, num_layers,
             ck, cv = cache_ctx["caches"][i]
             cache = {"k": ck, "v": cv, "mode": cache_ctx["mode"],
                      "slot": cache_ctx.get("slot"),
-                     "pos": cache_ctx.get("pos")}
+                     "pos": cache_ctx.get("pos"),
+                     "layout": cache_ctx.get("layout"),
+                     "table": cache_ctx.get("table"),
+                     "hist": cache_ctx.get("hist")}
             key_length = cache_ctx.get("key_length")
         x = transformer_encoder_layer(
             x, d_model, num_heads, d_ff, causal=True,
@@ -141,7 +153,9 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
                            d_ff=256, num_layers=2, max_len=16,
                            slots=None, cache_len=None,
                            prompt_buckets=None, bos_id=0, eos_id=1,
-                           cache_ns=None, dtype="float32"):
+                           cache_ns=None, dtype="float32", paged=None,
+                           block_size=None, num_blocks=None,
+                           prefix_cache=None):
     """Build the KV-cached generation programs for the causal LM — the
     O(L)-per-token production decode path (the O(L^2) reference is
     :func:`transformer_lm_generate`).
@@ -168,6 +182,33 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
     ``generation_slots`` / ``generation_cache_buckets`` /
     ``generation_prompt_buckets`` config flags (read only here — with
     no session built, generation costs nothing anywhere).
+
+    **Paged mode** (``paged=True``, default: the
+    ``generation_paged_kv`` flag): per-layer K/V storage becomes ONE
+    [num_blocks, block_size, d_model] block pool instead of dense
+    per-slot rows, and the programs route writes/attention through a
+    per-sequence block table feed (ops/generation_ops.py paged ops):
+
+    * **prefill** becomes a suffix-WINDOW prefill: tokens [1, P] plus
+      a ``hist`` feed — the first ``hist`` positions are already
+      cached (prefix blocks shared from an earlier admission), the
+      window's K/V rows are written through the table and its queries
+      attend the cached prefix plus themselves causally. ``hist=0``
+      is a plain prefill; the shape set stays one program per prompt
+      bucket regardless of hist.
+    * **decode** carries a [slots, max_blocks] table feed; the
+      attention gathers each slot's live blocks (the
+      ``flash_attention`` flag arms the block-table-gather Pallas
+      kernel; dense XLA shares the gather semantics).
+    * a tiny **block-copy program** (one compile) backs copy-on-write.
+
+    ``block_size`` / ``num_blocks`` / ``prefix_cache`` default to the
+    ``generation_block_size`` / ``generation_pool_blocks`` /
+    ``generation_prefix_cache`` flags; ``num_blocks=0`` auto-sizes to
+    byte parity with the dense layout (slots x ceil(cache_len /
+    block_size)). Slots and pool bytes are DECOUPLED: a paged session
+    can run more decode lanes than the dense layout could afford,
+    because a lane pins only its live blocks, not a worst-case row.
 
     Returns a :class:`paddle_tpu.serving.generation.GenerationSpec`
     consumed by ``GenerationSession`` / ``GenerationScheduler``.
@@ -198,7 +239,31 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
         # same scope never collide on cache names while still sharing
         # every parameter name
         cache_ns = _un.generate("kv_session")
-    cache_shape = (slots, cache_len, d_model)
+    if paged is None:
+        paged = bool(_config.get_flag("generation_paged_kv"))
+    max_blocks = 0
+    if paged:
+        if block_size is None:
+            block_size = int(_config.get_flag("generation_block_size"))
+        block_size = max(1, int(block_size))
+        max_blocks = -(-cache_len // block_size)   # ceil
+        if num_blocks is None:
+            num_blocks = int(_config.get_flag(
+                "generation_pool_blocks"))
+        if not num_blocks:
+            # byte parity with the dense layout by default — the win
+            # then comes purely from sharing + not pinning dead rows
+            num_blocks = slots * max_blocks
+        num_blocks = int(num_blocks)
+        if prefix_cache is None:
+            prefix_cache = bool(_config.get_flag(
+                "generation_prefix_cache"))
+        cache_shape = (num_blocks, block_size, d_model)
+    else:
+        block_size = 0
+        num_blocks = 0
+        prefix_cache = False
+        cache_shape = (slots, cache_len, d_model)
 
     def make_cache_vars(program):
         block = program.global_block()
@@ -224,15 +289,31 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
                                append_batch_size=False)
             ppos = layers.data("gen.ppos", shape=[1], dtype="int32",
                                append_batch_size=False)
-            slot = layers.data("gen.slot", shape=[1], dtype="int32",
-                               append_batch_size=False)
-            caches = make_cache_vars(prog)
+            if paged:
+                phist = layers.data("gen.phist", shape=[1],
+                                    dtype="int32",
+                                    append_batch_size=False)
+                ppix = layers.data("gen.ppix", shape=[P],
+                                   dtype="int32",
+                                   append_batch_size=False)
+                ptab = layers.data("gen.ptab", shape=[max_blocks],
+                                   dtype="int32",
+                                   append_batch_size=False)
+                cache_ctx = {"mode": "prefill", "layout": "paged",
+                             "caches": None, "table": ptab,
+                             "hist": phist, "pos_idx": ppix,
+                             "key_length": plen, "max_len": max_len}
+            else:
+                slot = layers.data("gen.slot", shape=[1],
+                                   dtype="int32",
+                                   append_batch_size=False)
+                cache_ctx = {"mode": "prefill", "caches": None,
+                             "slot": slot, "key_length": plen,
+                             "max_len": max_len}
+            cache_ctx["caches"] = make_cache_vars(prog)
             logits = _lm_backbone(
                 toks, vocab_size, d_model, num_heads, d_ff, num_layers,
-                is_test=True,
-                cache_ctx={"mode": "prefill", "caches": caches,
-                           "slot": slot, "key_length": plen,
-                           "max_len": max_len})
+                is_test=True, cache_ctx=cache_ctx)
             # logits at the last REAL prompt position (ppos = len-1):
             # [1,P,V] -> [P,1,V] -> [1,1,V] -> [1,V] -> argmax [1]
             by_time = layers.transpose(logits, [1, 0, 2])
@@ -248,15 +329,43 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
                            append_batch_size=False)
         dpos = layers.data("gen.dpos", shape=[slots], dtype="int32",
                            append_batch_size=False)
-        caches = make_cache_vars(decode_program)
+        if paged:
+            dtab = layers.data("gen.dtab", shape=[slots, max_blocks],
+                               dtype="int32", append_batch_size=False)
+            cache_ctx = {"mode": "decode", "layout": "paged",
+                         "caches": None, "table": dtab, "pos": dpos,
+                         "max_len": max_len}
+        else:
+            cache_ctx = {"mode": "decode", "caches": None, "pos": dpos,
+                         "max_len": max_len}
+        cache_ctx["caches"] = make_cache_vars(decode_program)
         logits = _lm_backbone(
             toks, vocab_size, d_model, num_heads, d_ff, num_layers,
-            is_test=True,
-            cache_ctx={"mode": "decode", "caches": caches, "pos": dpos,
-                       "max_len": max_len})
+            is_test=True, cache_ctx=cache_ctx)
         row = layers.reshape(logits, [slots, vocab_size])
         nxt = layers.argmax(row, axis=-1)
     decode_fetch = nxt.name
+
+    copy_program = None
+    if paged:
+        # copy-on-write primitive: block Src -> block Dst in EVERY
+        # layer's K and V pool (one block id addresses the same row
+        # range of all of them). One program, one compile, feeds only.
+        copy_program = Program()
+        with _un.guard(), program_guard(copy_program, Program()):
+            csrc = layers.data("gen.csrc", shape=[1], dtype="int32",
+                               append_batch_size=False)
+            cdst = layers.data("gen.cdst", shape=[1], dtype="int32",
+                               append_batch_size=False)
+            cblock = copy_program.global_block()
+            for ck, cv in make_cache_vars(copy_program):
+                for cvar in (ck, cv):
+                    cblock.append_op(
+                        type="kv_block_copy",
+                        inputs={"Cache": [cvar.name],
+                                "Src": [csrc.name],
+                                "Dst": [cdst.name]},
+                        outputs={"Out": [cvar.name]})
 
     def _rebuild():
         # the session-rebuild factory (serving.generation): identical
@@ -268,7 +377,10 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
             d_ff=d_ff, num_layers=num_layers, max_len=max_len,
             slots=slots, cache_len=cache_len,
             prompt_buckets=prompt_buckets, bos_id=bos_id,
-            eos_id=eos_id, cache_ns=None, dtype=dtype)
+            eos_id=eos_id, cache_ns=None, dtype=dtype, paged=paged,
+            block_size=block_size or None,
+            num_blocks=num_blocks or None,
+            prefix_cache=prefix_cache)
 
     return GenerationSpec(
         slots=slots, cache_len=cache_len, max_len=max_len,
@@ -277,9 +389,18 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
                           dtype)
                          for i in range(num_layers) for kv in ("k", "v")),
         prefill_programs=prefill_programs,
-        prefill_feeds=("gen.ptok", "gen.plen", "gen.ppos", "gen.slot"),
+        prefill_feeds=(("gen.ptok", "gen.plen", "gen.ppos",
+                        "gen.phist", "gen.ppix", "gen.ptab") if paged
+                       else ("gen.ptok", "gen.plen", "gen.ppos",
+                             "gen.slot")),
         prefill_fetch=prefill_fetch,
         decode_program=decode_program,
-        decode_feeds=("gen.dtok", "gen.dpos"),
+        decode_feeds=(("gen.dtok", "gen.dpos", "gen.dtab") if paged
+                      else ("gen.dtok", "gen.dpos")),
         decode_fetch=decode_fetch,
-        rebuild=_rebuild)
+        rebuild=_rebuild,
+        paged=bool(paged), block_size=block_size,
+        num_blocks=num_blocks, max_blocks=max_blocks,
+        prefix_cache=bool(prefix_cache),
+        copy_program=copy_program,
+        copy_feeds=("gen.csrc", "gen.cdst") if paged else None)

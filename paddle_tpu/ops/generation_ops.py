@@ -24,6 +24,36 @@ row against the live prefix.
 All shapes here are static (slots and cache_len are compile-time
 bucket sizes): the executor compile cache sees exactly one decode
 entry per (slot-bucket, cache-bucket) pair.
+
+Paged mode (``generation_paged_kv``): per-layer K/V storage is ONE
+[num_blocks, block_size, d_model] pool instead of dense per-slot rows;
+a sequence's logical position p lives at pool row
+``table[p // block_size] * block_size + p % block_size`` where
+``table`` is its host-side block table (serving/paged_cache.py).
+
+* ``kv_cache_write_paged``  — prefill a token WINDOW: rows of the
+  window land at positions [Hist, Hist+Len) through the table (the
+  prefix-cache suffix prefill: Hist > 0 means the first Hist
+  positions are already cached, shared from another sequence).
+* ``kv_cache_append_paged`` — decode: one row per slot through its
+  own table row; dead table entries (>= num_blocks) DROP the write
+  (inactive/starved slots can't scribble on blocks they don't own).
+* ``multihead_attention_decode_paged`` / the prefill variant — the
+  same masking contract as the dense ops, with K/V gathered through
+  the table: the Pallas block-gather kernel
+  (``decode_attention_paged``) when ``flash_attention`` is on, an XLA
+  gather sharing identical semantics otherwise — the flag never
+  changes tokens.
+* ``kv_block_copy`` — one block pool-to-pool (copy-on-write: a
+  sequence about to write into a shared block copies it first).
+
+All writes keep the donation contract: Out aliases the pool variable
+name, the scatter/dynamic_update_slice lands in place in HBM.
+
+Shapes stay static here too (block tables are fixed-width feeds padded
+with dead entries): paged mode adds exactly one decode entry and one
+prefill entry per bucket to the compile cache, plus one block-copy
+program — the shape set stays closed.
 """
 
 import jax
@@ -60,6 +90,141 @@ def _kv_cache_append(ctx):
         return jax.lax.dynamic_update_slice(c, n, (p, jnp.int32(0)))
 
     return {"Out": jax.vmap(upd)(cache, new, pos)}
+
+
+@register_op("kv_cache_write_paged")
+def _kv_cache_write_paged(ctx):
+    """Cache [NB, BS, D] pool, New [1, T, D], Table [MB] int, Hist [1]
+    int, Len [1] int -> Out = pool with New's rows i in [0, Len)
+    written at logical positions Hist+i through Table. Rows at or past
+    Len scatter out of bounds and DROP (window padding never lands);
+    Out aliases the pool variable, so the donated state update keeps
+    the scatter in place."""
+    pool = ctx.input("Cache")
+    new = ctx.input("New")
+    table = ctx.input("Table").reshape(-1).astype(jnp.int32)
+    hist = ctx.input("Hist").reshape(-1)[0].astype(jnp.int32)
+    ln = ctx.input("Len").reshape(-1)[0].astype(jnp.int32)
+    nb, bs, d = pool.shape
+    t = new.shape[1]
+    idx = jnp.arange(t, dtype=jnp.int32)
+    pos = hist + idx
+    blk = table[jnp.clip(pos // bs, 0, table.shape[0] - 1)]
+    rows = blk * bs + pos % bs
+    rows = jnp.where(idx < ln, rows, nb * bs)   # padding -> dropped
+    flat = pool.reshape(nb * bs, d)
+    flat = flat.at[rows].set(new[0].astype(pool.dtype), mode="drop")
+    return {"Out": flat.reshape(nb, bs, d)}
+
+
+@register_op("kv_cache_append_paged")
+def _kv_cache_append_paged(ctx):
+    """Cache [NB, BS, D] pool, New [S, 1, D], Pos [S] int, Table
+    [S, MB] int -> Out = pool with slot s's row written at its
+    table-mapped position. A dead table entry (>= NB — how the host
+    marks inactive or pool-starved slots) pushes the scatter out of
+    bounds, so the write DROPS instead of corrupting a block another
+    sequence owns."""
+    pool = ctx.input("Cache")
+    new = ctx.input("New")
+    pos = ctx.input("Pos").reshape(-1).astype(jnp.int32)
+    table = ctx.input("Table").astype(jnp.int32)
+    nb, bs, d = pool.shape
+    s = new.shape[0]
+    bi = jnp.clip(pos // bs, 0, table.shape[1] - 1)
+    blk = table[jnp.arange(s), bi]
+    rows = blk * bs + pos % bs       # blk >= NB -> out of bounds
+    flat = pool.reshape(nb * bs, d)
+    flat = flat.at[rows].set(new[:, 0, :].astype(pool.dtype),
+                             mode="drop")
+    return {"Out": flat.reshape(nb, bs, d)}
+
+
+@register_op("kv_block_copy")
+def _kv_block_copy(ctx):
+    """Cache [NB, BS, D] pool, Src [1] int, Dst [1] int -> Out = pool
+    with block Dst overwritten by block Src — the copy-on-write
+    primitive: a sequence about to write into a shared block copies it
+    into a fresh one first, so co-resident sequences never see each
+    other's writes. In place via donation like every cache op."""
+    pool = ctx.input("Cache")
+    src = ctx.input("Src").reshape(-1)[0].astype(jnp.int32)
+    dst = ctx.input("Dst").reshape(-1)[0].astype(jnp.int32)
+    _, bs, d = pool.shape
+    zero = jnp.int32(0)
+    blk = jax.lax.dynamic_slice(pool, (src, zero, zero), (1, bs, d))
+    return {"Out": jax.lax.dynamic_update_slice(pool, blk,
+                                                (dst, zero, zero))}
+
+
+@register_op("multihead_attention_decode_paged")
+def _multihead_attention_decode_paged(ctx):
+    """Q [S, 1, H*D], CacheK/CacheV [NB, BS, H*D] pools, Pos [S] int
+    (the row each slot's new token was just written to), Table [S, MB]
+    int; attr num_heads. Out [S, 1, H*D]: each slot's single query
+    attends its table-gathered cache rows [0, Pos[s]] — the paged
+    twin of ``multihead_attention_decode``, same masking/softmax
+    contract (token parity with the dense layout is a test
+    invariant). ``flash_attention`` routes to the block-table-gather
+    Pallas kernel; the XLA fallback gathers the same rows densely."""
+    q = ctx.input("Q")
+    ck = ctx.input("CacheK")
+    cv = ctx.input("CacheV")
+    length = ctx.input("Pos").reshape(-1).astype(jnp.int32) + 1
+    table = ctx.input("Table")
+    nh = ctx.attr("num_heads")
+
+    from .. import config as _config
+    if _config.get_flag("flash_attention"):
+        from .pallas_attention import decode_attention_paged
+        return {"Out": decode_attention_paged(q, ck, cv, length,
+                                              table, nh)}
+    from .pallas_attention import _decode_paged_reference
+    return {"Out": _decode_paged_reference(q, ck, cv, length, table,
+                                           nh)}
+
+
+@register_op("multihead_attention_prefill_paged")
+def _multihead_attention_prefill_paged(ctx):
+    """Q [1, P, H*D] (a prompt-suffix window whose K/V rows were just
+    written through the table), CacheK/CacheV [NB, BS, H*D] pools,
+    Table [MB] int, Hist [1] int, Len [1] int; attr num_heads.
+    Out [1, P, H*D]: window row i (logical position Hist+i) attends
+    table-gathered cache rows [0, Hist+i] — causal over the cached
+    prefix PLUS the window itself, which is what lets a shared-prefix
+    admission prefill only its unshared suffix. Rows at or past Len
+    are padding: they compute garbage that is neither fetched nor
+    written (the paged write op drops their K/V), and real rows never
+    attend them (their positions are beyond every real row's mask).
+    Dense XLA only — this runs once per admission, not per step; the
+    per-step Pallas path is the decode op."""
+    q = ctx.input("Q")
+    ck = ctx.input("CacheK")
+    cv = ctx.input("CacheV")
+    table = ctx.input("Table").reshape(-1).astype(jnp.int32)
+    hist = ctx.input("Hist").reshape(-1)[0].astype(jnp.int32)
+    nh = ctx.attr("num_heads")
+    _, p, dm = q.shape
+    nb, bs, _ = ck.shape
+    mb = table.shape[0]
+    c = mb * bs
+    hd = dm // nh
+    tbl = jnp.clip(table, 0, nb - 1)
+    k = ck[tbl].reshape(c, dm)
+    v = cv[tbl].reshape(c, dm)
+    qh = q.reshape(p, nh, hd).transpose(1, 0, 2)        # [H, P, hd]
+    kh = k.reshape(c, nh, hd).transpose(1, 0, 2)        # [H, C, hd]
+    vh = v.reshape(c, nh, hd).transpose(1, 0, 2)
+    s = jnp.einsum("hqd,hkd->hqk", qh, kh,
+                   preferred_element_type=jnp.float32)
+    s = s * (hd ** -0.5)
+    cols = jnp.arange(c, dtype=jnp.int32)
+    rows = hist + jnp.arange(p, dtype=jnp.int32)
+    mask = cols[None, None, :] <= rows[None, :, None]
+    s = jnp.where(mask, s, -1e30)
+    prob = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    out = jnp.einsum("hqk,hkd->hqd", prob, vh)
+    return {"Out": out.transpose(1, 0, 2).reshape(1, p, dm)}
 
 
 @register_op("multihead_attention_decode")

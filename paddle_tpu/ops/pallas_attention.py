@@ -366,6 +366,168 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
                  l_ref, **kw)
 
 
+# -- paged decode: block-table gather over a block-pool cache ------------
+
+def _decode_paged_reference(q, k_pool, v_pool, lengths, tables,
+                            num_heads):
+    """Dense XLA single-query attention over a PAGED cache: q [S, 1, D]
+    (one query token per slot, D = heads*head_dim), k/v pools
+    [NB, BS, D], lengths [S] (live rows per slot), tables [S, MB]
+    block ids mapping slot s's logical rows [j*BS, (j+1)*BS) to pool
+    block tables[s, j]. Table entries >= NB mark dead/unallocated
+    rows (clipped for the gather; the length mask keeps them
+    unattendable). The flag-off fallback AND the numeric contract the
+    paged kernel must match: after the gather this is exactly
+    :func:`_decode_reference` on the logical [S, MB*BS] cache, so the
+    paged and dense layouts are token-identical by construction."""
+    s, _, dm = q.shape
+    nb, bs, _ = k_pool.shape
+    mb = tables.shape[1]
+    c = mb * bs
+    hd = dm // num_heads
+    tbl = jnp.clip(tables.astype(jnp.int32), 0, nb - 1)
+    k = k_pool[tbl].reshape(s, c, dm)
+    v = v_pool[tbl].reshape(s, c, dm)
+    qh = q.reshape(s, num_heads, hd)
+    kh = k.reshape(s, c, num_heads, hd).transpose(0, 2, 1, 3)
+    vh = v.reshape(s, c, num_heads, hd).transpose(0, 2, 1, 3)
+    lens = jnp.broadcast_to(
+        jnp.asarray(lengths).reshape(s, 1), (s, num_heads))
+    out = _decode_reference(qh.reshape(s * num_heads, 1, hd),
+                            kh.reshape(s * num_heads, c, hd),
+                            vh.reshape(s * num_heads, c, hd),
+                            lens.reshape(s * num_heads))
+    return out.reshape(s, 1, dm)
+
+
+def _decode_paged_body(lens_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc_ref, m_ref, l_ref, *, scale, block_k, nk):
+    """One block step of single-query flash decode THROUGH a block
+    table. Grid (slot, head, block); the block axis is sequential, so
+    the VMEM scratch carries the online softmax per (slot, head). The
+    gather lives in the BlockSpec index maps (scalar-prefetched table
+    entries pick which pool block the next HBM->VMEM copy fetches);
+    this body only predicates dead blocks off and masks the tail —
+    per-step HBM traffic is O(length) pool rows, exactly the live
+    blocks of each sequence."""
+    si, ki = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full(m_ref.shape, _NEG, m_ref.dtype)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = lens_ref[si]
+    live = ki * block_k < length
+
+    @pl.when(live)
+    def _step():
+        s = jnp.dot(q_ref[0], k_ref[0].T,
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.DEFAULT) * scale
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        mask = cols < length
+        s = jnp.where(mask, s, _NEG)
+        m_prev = m_ref[:]                          # [1, 128]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        p = jnp.where(mask, p, 0.0)
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=-1,
+                                              keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha[:, :1] + jnp.dot(
+            p.astype(v_ref.dtype), v_ref[0],
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        m_ref[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _decode_paged_kernel(lens_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, **kw):
+    _decode_paged_body(lens_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc_ref, m_ref, l_ref, **kw)
+
+
+def decode_attention_paged(q, k_pool, v_pool, lengths, tables,
+                           num_heads, interpret=None):
+    """Block-table-gather mode of :func:`decode_attention`: single-query
+    flash decode where K/V live in a PAGED pool and scalar-prefetched
+    block indices drive the index maps, so the kernel streams exactly
+    the live blocks of each sequence — never the whole pool, never a
+    gathered dense copy.
+
+    q: [S, 1, D] (one query per slot, D = num_heads * head_dim);
+    k_pool/v_pool: [NB, BS, D]; lengths: [S]; tables: [S, MB] int
+    block ids (entries >= NB are dead — clamped, masked by length).
+    Returns [S, 1, D]. The k-block size IS the pool's block_size: the
+    grid walks (slot, head, logical block), the index map looks the
+    physical block up in the prefetched table (dead/tail blocks revisit
+    the last live index, so no HBM fetch is issued for them — the
+    PR-8 decode kernel's clamp trick, now through a level of
+    indirection), and the head picks its head_dim column slice of the
+    pool block. Ragged pool geometry falls back to the dense gather
+    reference — same semantics, so the flag never changes tokens.
+    ``interpret=None`` auto-selects interpreter mode off-TPU."""
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+    s, _, dm = q.shape
+    nb, bs, _ = k_pool.shape
+    mb = tables.shape[1]
+    hd = dm // num_heads
+    if not interpret and (bs % 16 != 0 or hd % 16 != 0):
+        # compiled Mosaic wants tileable block rows/lanes; ragged
+        # geometry takes the XLA gather path (identical semantics)
+        return _decode_paged_reference(q, k_pool, v_pool, lengths,
+                                       tables, num_heads)
+    from jax.experimental.pallas import tpu as pltpu
+    lens = jnp.asarray(lengths).reshape(s).astype(jnp.int32)
+    tab = jnp.asarray(tables).reshape(s * mb).astype(jnp.int32)
+
+    def kv_index(si, hi, j, lens_ref, tab_ref):
+        # logical block j of slot si -> physical pool block. Dead
+        # blocks (past the live prefix) clamp to the last LIVE logical
+        # block before the table lookup: Pallas issues the HBM->VMEM
+        # copy per BlockSpec index, so revisiting a resident index
+        # makes the skip real at the memory level (the body's pl.when
+        # alone only skips compute). The id is also clamped into the
+        # pool, so an inactive slot's dead-marker entries (>= NB)
+        # can't index out of bounds.
+        last = jnp.maximum(lens_ref[si] - 1, 0) // bs
+        blk = tab_ref[si * mb + jnp.minimum(j, last)]
+        return (jnp.clip(blk, 0, nb - 1), 0, hi)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, num_heads, mb),
+        in_specs=[
+            pl.BlockSpec((1, 1, hd),
+                         lambda si, hi, j, lr, tr: (si, 0, hi)),
+            pl.BlockSpec((1, bs, hd), kv_index),
+            pl.BlockSpec((1, bs, hd), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, hd),
+                               lambda si, hi, j, lr, tr: (si, 0, hi)),
+        scratch_shapes=[
+            pltpu.VMEM((1, hd), jnp.float32),     # acc
+            pltpu.VMEM((1, 128), jnp.float32),    # running max
+            pltpu.VMEM((1, 128), jnp.float32),    # running sum
+        ])
+    return pl.pallas_call(
+        functools.partial(_decode_paged_kernel, scale=hd ** -0.5,
+                          block_k=bs, nk=mb),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, 1, dm), q.dtype),
+        interpret=interpret)(lens, tab, q, k_pool, v_pool)
+
+
 def decode_attention(q, k, v, lengths, interpret=None):
     """Single-query flash attention against an on-device KV cache —
     the decode-mode variant of :func:`flash_attention` (inference only,

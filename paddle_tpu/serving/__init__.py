@@ -31,6 +31,11 @@ Four cooperating pieces:
   ``submit(prompt) -> Future`` with deadlines/backpressure/shedding,
   mid-flight slot-level admit/retire, per-session breakers, drain,
   and between-step weight swap).
+* :mod:`paged_cache` — the paged-KV memory tier behind
+  ``generation_paged_kv``: :class:`BlockPool` (fixed-size block
+  allocator with refcounts over the per-layer K/V pools) and
+  :class:`PrefixIndex` (content-hashed prompt caching: shared prefix
+  blocks, copy-on-write divergence, LRU eviction under pressure).
 
 Everything is instrumented through :mod:`paddle_tpu.observability`;
 ``tools/serving_probe.py`` exercises the stack headless and
@@ -50,10 +55,13 @@ from .engine import ServingEngine  # noqa: F401
 from .batcher import MicroBatcher, ServingOverloadError  # noqa: F401
 from .generation import (GenerationScheduler,  # noqa: F401
                          GenerationSession, GenerationSpec)
+from .paged_cache import (BlockPool, PoolExhausted,  # noqa: F401
+                          PrefixIndex)
 
 __all__ = ["ServingEngine", "MicroBatcher", "ServingOverloadError",
            "ServingDeadlineError", "ServingTimeoutError",
            "ServingUnavailableError", "SwapRejectedError",
            "ReplicaBreaker", "GenerationSession", "GenerationScheduler",
-           "GenerationSpec", "deploy", "generation", "quant",
-           "resilience"]
+           "GenerationSpec", "BlockPool", "PrefixIndex",
+           "PoolExhausted", "deploy", "generation", "paged_cache",
+           "quant", "resilience"]

@@ -211,15 +211,33 @@ class GenerationSpec:
       thread may finish long after the rebuild and republish the OLD
       cache names into the scope; under a new namespace those writes
       land on orphaned variables, never on the replacement's state.
+
+    Paged mode (``paged=True``): ``cache_vars`` are
+    [num_blocks, block_size, d_model] block POOLS, the programs carry
+    block-table feeds (``prefill_feeds`` = (tokens, len, last_pos,
+    hist, pos_idx, table); ``decode_feeds`` = (tokens, positions,
+    tables)), ``copy_program``/``copy_feeds`` name the copy-on-write
+    block-copy program, ``max_blocks`` is the per-sequence table
+    width (ceil(cache_len / block_size)), and ``prefix_cache`` arms
+    the content-hashed prompt-block index (serving/paged_cache.py).
     """
 
     __slots__ = ("slots", "cache_len", "max_len", "prompt_buckets",
                  "bos_id", "eos_id", "cache_vars", "prefill_programs",
                  "prefill_feeds", "prefill_fetch", "decode_program",
-                 "decode_feeds", "decode_fetch", "rebuild")
+                 "decode_feeds", "decode_fetch", "rebuild", "paged",
+                 "block_size", "num_blocks", "max_blocks",
+                 "prefix_cache", "copy_program", "copy_feeds")
 
     def __init__(self, **kwargs):
         kwargs.setdefault("rebuild", None)
+        kwargs.setdefault("paged", False)
+        kwargs.setdefault("block_size", 0)
+        kwargs.setdefault("num_blocks", 0)
+        kwargs.setdefault("max_blocks", 0)
+        kwargs.setdefault("prefix_cache", False)
+        kwargs.setdefault("copy_program", None)
+        kwargs.setdefault("copy_feeds", None)
         for name in self.__slots__:
             setattr(self, name, kwargs.pop(name))
         if kwargs:
@@ -269,6 +287,27 @@ class GenerationSession:
         # the deepest position any sequence may WRITE: bounded by the
         # cache bucket and by the learned position table
         self.max_pos = min(spec.cache_len, spec.max_len)
+        # -- paged block-pool state (spec.paged; serving/paged_cache) --
+        self.paged = bool(getattr(spec, "paged", False))
+        self.pool = None
+        self.prefix = None
+        if self.paged:
+            from .paged_cache import BlockPool, PrefixIndex
+            self.pool = BlockPool(spec.num_blocks, spec.block_size)
+            if spec.prefix_cache:
+                self.prefix = PrefixIndex(self.pool)
+            # host-side block table per slot: physical block ids
+            # backing logical rows [0, lengths[slot])
+            self.tables = [[] for _ in range(n)]
+            # slots whose next write found no allocatable block this
+            # step — excluded from step() results; the scheduler (or
+            # generate()) finishes them at their current length
+            self._starved = set()
+            # (bucket, hist, window_len) per prefill — the probe/test
+            # surface proving a shared prefix was NOT re-prefilled;
+            # bounded (see _admit_paged) so a long-lived session
+            # doesn't accumulate host memory per admission
+            self.prefill_log = []
 
     # -- slot bookkeeping ------------------------------------------------
     def free_slots(self):
@@ -294,10 +333,165 @@ class GenerationSession:
     def compile_stats(self):
         return self.exe.compile_stats()
 
+    # -- paged-pool surface (no-ops / trivial on the dense layout) -------
+    def admit_ok(self, n_tokens):
+        """Can an ``n_tokens``-history admission get storage RIGHT NOW?
+        Dense: always (storage is the slot itself — ``free_slots`` is
+        the gate). Paged: enough free-or-evictable blocks to cover the
+        whole history PLUS one copy-on-write block when the prefix
+        cache is armed. The accounting is sharing-independent: if the
+        admission matches m cached blocks it needs m fewer fresh ones
+        but also pins those m previously-evictable entries, so the two
+        cancel and ``free + evictable >= ceil(n/bs) + cow_margin`` is
+        the right test without knowing the tokens. The scheduler
+        consults this during placement so pool pressure parks a
+        request instead of turning into an admit exception that would
+        charge a healthy session's breaker."""
+        if not self.paged:
+            return True
+        need = -(-min(int(n_tokens), self.max_pos)
+                 // self.spec.block_size)
+        avail = self.pool.free_count()
+        if self.prefix is not None:
+            # a matched prefix ending mid-block copies-on-write one
+            # extra block during the admission itself — but never
+            # demand more than the pool HAS: a history that needs
+            # exactly the whole pool can only need the COW block when
+            # something matched, in which case the match freed that
+            # many fresh allocations; capping keeps such a request
+            # admittable instead of parked forever
+            need = min(need + 1, self.pool.num_blocks)
+            if avail < need:
+                avail += self.prefix.evictable_count()
+        return avail >= need
+
+    def storable(self, n_tokens):
+        """Static bound: could this session's storage EVER hold an
+        ``n_tokens`` history? Dense storage is the slot row itself
+        (``max_pos`` covers it); a paged pool must have enough blocks
+        IN TOTAL — placement must not park a request forever on a
+        pool that can never satisfy it, however much retires free."""
+        if not self.paged:
+            return True
+        return -(-int(n_tokens) // self.spec.block_size) <= \
+            self.pool.num_blocks
+
+    def window_fits(self, history):
+        """Placement probe for a history whose FULL length fits no
+        prompt bucket: with the prefix cache armed, the cached prefix
+        shrinks the window that actually needs one — a PR-9 replay
+        journal that outgrew every bucket is still admissible here
+        when its prompt prefix is cached, so failover composes with
+        prefix reuse instead of dying on bucket promotion. Entirely
+        side-effect-free (``PrefixIndex.peek``); dense sessions and
+        prefix-off pools return False, preserving the old verdict."""
+        if not self.paged or self.prefix is None:
+            return False
+        history = np.asarray(history, np.int64).reshape(-1)
+        n = history.size
+        if n < 1 or n > self.max_pos:
+            return False
+        matched = self.prefix.peek(history[:n - 1])
+        return self.prompt_bucket(n - matched) is not None
+
+    def pool_stats(self):
+        """{blocks_in_use, num_blocks, block_size, bytes_per_block}
+        for the paged layout (None on dense) — probe/bench surface."""
+        if not self.paged:
+            return None
+        itemsize = np.dtype(self.spec.cache_vars[0][2]).itemsize
+        d_model = self.spec.cache_vars[0][1][2]
+        return {"blocks_in_use": self.pool.used_count(),
+                "num_blocks": self.pool.num_blocks,
+                "block_size": self.spec.block_size,
+                "bytes_per_block": self.spec.block_size * d_model
+                * itemsize * len(self.spec.cache_vars)}
+
+    def prefix_stats(self):
+        """Prefix-cache hit counters (zeros when not armed)."""
+        if self.prefix is None:
+            return {"hits": 0, "misses": 0, "shared_tokens": 0,
+                    "entries": 0}
+        return self.prefix.stats()
+
+    def check_pool_invariant(self):
+        """Assert the block-pool books balance against every live
+        table and index pin (serving/paged_cache.py) — the
+        pool-accounting invariant tests assert after retire / close /
+        failover so a leaked block fails loudly. No-op on dense."""
+        if self.paged:
+            self.pool.check_invariant(
+                (self.tables[s] for s in range(self.spec.slots)),
+                self.prefix)
+
+    def _alloc_block(self):
+        """One fresh block, reclaiming cold prefix-cache entries under
+        pressure (LRU, pin-only) before giving up."""
+        from .paged_cache import PoolExhausted
+        while True:
+            try:
+                return self.pool.alloc()
+            except PoolExhausted:
+                if self.prefix is None or not self.prefix.evict_one():
+                    raise
+
+    def _release_table(self, slot):
+        for block in self.tables[slot]:
+            self.pool.decref(block)
+        self.tables[slot] = []
+
+    def _copy_block(self, src, dst):
+        """Run the block-copy program: block ``src`` -> ``dst`` in
+        every layer's K and V pool (device-side, in place under
+        donation — COW never round-trips the cache through the
+        host)."""
+        f_src, f_dst = self.spec.copy_feeds
+        self.exe.run(self.spec.copy_program,
+                     feed={f_src: np.asarray([src], np.int32),
+                           f_dst: np.asarray([dst], np.int32)},
+                     fetch_list=[], scope=self.scope)
+
+    def _ensure_writable(self, table, idx):
+        """Copy-on-write: if ``table[idx]`` is shared (another
+        sequence's table or a prefix-index pin also holds it), copy it
+        into a fresh block and swap that into the table — the writer
+        diverges onto its own copy, sharers keep the original
+        untouched. Raises PoolExhausted when no block is allocatable."""
+        from .paged_cache import BLOCK_COWS
+        old = table[idx]
+        if self.pool.refcount(old) <= 1:
+            return
+        new = self._alloc_block()
+        try:
+            self._copy_block(old, new)
+        except BaseException:
+            self.pool.decref(new)
+            raise
+        self.pool.decref(old)
+        table[idx] = new
+        BLOCK_COWS.inc()
+
     def close(self):
         """Release this session's cache-variable claim (and drop the
         cache arrays from the scope), so a later session may reuse the
-        names. Idempotent; the session must not be stepped after."""
+        names. Paged: every block reference — slot tables AND prefix
+        pins — is returned to the pool first, and the accounting
+        invariant is re-checked so a teardown (including the PR-9
+        rebuild path, which closes the old session on hand-over) can
+        never leak a block. Idempotent; the session must not be
+        stepped after."""
+        if self.paged and self.pool is not None:
+            for slot in range(self.spec.slots):
+                self._release_table(slot)
+            if self.prefix is not None:
+                self.prefix.clear()
+            self.check_pool_invariant()
+            assert self.pool.used_count() == 0, \
+                "closed session leaked %d blocks" % self.pool.used_count()
+            self.pool.close()
+            self.pool = None
+            self.prefix = None
+            self.paged = False
         claimed = _CACHE_CLAIMS.get(self.scope)
         if claimed is not None:
             claimed -= self._claimed
@@ -312,11 +506,20 @@ class GenerationSession:
         prompt's K/V rows land in the cache, the slot becomes active,
         and the first greedy token is returned as ``(slot, token)``.
         Raises RuntimeError when no slot is free and ValueError when
-        the prompt fits no bucket."""
+        the prompt fits no bucket.
+
+        Paged layout: storage comes from the block pool through a
+        fresh block table; with the prefix cache armed, the longest
+        content-hash-matched prefix is SHARED (its blocks referenced,
+        not recomputed) and only the unshared suffix is prefilled —
+        capped at len-1, because logits need the last prompt token's
+        hidden state, which only a forward pass produces."""
         prompt = np.asarray(prompt, np.int64).reshape(-1)
         n = prompt.size
         if n < 1:
             raise ValueError("empty prompt")
+        if self.paged:
+            return self._admit_paged(prompt)
         bucket = self.prompt_bucket(n)
         if bucket is None:
             raise ValueError(
@@ -345,6 +548,89 @@ class GenerationSession:
         _PREFILLS.labels(bucket=bucket).inc()
         return slot, first
 
+    def _admit_paged(self, prompt):
+        """Paged admission: match the cached prefix, reference its
+        blocks, allocate fresh ones for the rest, prefill ONLY the
+        unshared suffix window, then register the prompt's blocks in
+        the prefix index. All block references taken here are rolled
+        back if anything below fails — the pool can't leak on an
+        admission error."""
+        n = prompt.size
+        bs = self.spec.block_size
+        if n > self.max_pos:
+            raise ValueError(
+                "prompt length %d exceeds the cache capacity %d"
+                % (n, self.max_pos))
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free cache slot (%d active)"
+                               % self.spec.slots)
+        slot = free[0]
+        matched, shared = 0, []
+        if self.prefix is not None:
+            # cap at n-1: the final prompt token is always re-run —
+            # its logits come from hidden states, which are not cached
+            matched, shared = self.prefix.match(prompt[:n - 1])
+        suffix = prompt[matched:]
+        bucket = self.prompt_bucket(suffix.size)
+        if bucket is None:
+            raise ValueError(
+                "prompt length %d (unshared suffix %d) exceeds the "
+                "largest prompt bucket %d"
+                % (n, suffix.size, self.spec.prompt_buckets[-1]))
+        table = list(shared)
+        for block in shared:
+            self.pool.incref(block)
+        try:
+            if matched % bs:
+                # the matched prefix ends MID-block: the suffix writes
+                # into that shared block, so diverge onto a copy first
+                self._ensure_writable(table, len(table) - 1)
+            while len(table) * bs < n:
+                table.append(self._alloc_block())
+            w = suffix.size
+            padded = np.full((1, bucket), self.spec.eos_id, np.int64)
+            padded[0, :w] = suffix
+            pix = np.clip(matched + np.arange(bucket), 0,
+                          self.spec.max_len - 1).astype(np.int32)
+            tab = np.full(self.spec.max_blocks, self.pool.num_blocks,
+                          np.int32)
+            tab[:len(table)] = table
+            f_tok, f_len, f_pos, f_hist, f_pix, f_tab = \
+                self.spec.prefill_feeds
+            with _tracing.span("generationPrefill", bucket=bucket,
+                               hist=matched):
+                outs = self.exe.run(
+                    self.spec.prefill_programs[bucket],
+                    feed={f_tok: padded,
+                          f_len: np.asarray([w], np.int32),
+                          f_pos: np.asarray([w - 1], np.int32),
+                          f_hist: np.asarray([matched], np.int32),
+                          f_pix: pix,
+                          f_tab: tab},
+                    fetch_list=[self.spec.prefill_fetch],
+                    scope=self.scope)
+        except BaseException:
+            for block in table:
+                self.pool.decref(block)
+            raise
+        first = int(np.asarray(outs[0]).reshape(-1)[0])
+        if self.prefix is not None:
+            # publish the prompt's blocks (full chunks + partial
+            # tail) — the next admission sharing this prefix, or a
+            # PR-9 token replay of it, prefills only its suffix
+            self.prefix.register(prompt, table)
+        self.tables[slot] = table
+        self.lengths[slot] = n
+        self.last_token[slot] = first
+        self.active[slot] = True
+        self._starved.discard(slot)
+        self.prefill_log.append((bucket, matched, w))
+        if len(self.prefill_log) > 4096:     # keep a list (tests
+            del self.prefill_log[:2048]      # slice it), bounded
+        _PREFILLS.labels(bucket=bucket).inc()
+        return slot, first
+
     def step(self):
         """One decode step for EVERY active slot: each slot's pending
         token is embedded at its own position, its K/V row appended in
@@ -352,28 +638,116 @@ class GenerationSession:
         prefix. Returns {slot: next_token} for active slots (free
         slots compute masked garbage that the next prefill
         overwrites). Raises RuntimeError when an active slot is out of
-        cache capacity — retire it first."""
+        cache capacity — retire it first.
+
+        Paged layout: a slot whose next write needs a block the pool
+        cannot supply (even after evicting cold prefix entries) is
+        EXCLUDED from the result — it neither advances nor writes
+        (its table feed row is dead, so the device write drops) and
+        the caller finishes it at its current length. Dense sessions
+        never exclude a slot.
+
+        Internally two phases — :meth:`step_prepare` (ALL host-side
+        pool/table mutation) then :meth:`step_run` (the device call) —
+        so the scheduler's bounded-step path can keep allocator books
+        off the worker thread (see step_prepare)."""
+        prepared = self.step_prepare()
+        if prepared is None:
+            return {}
+        return self.step_run(prepared)
+
+    def step_prepare(self):
+        """Phase 1 of a decode step: the active-slot snapshot, the
+        capacity check, and — on the paged layout — EVERY host-side
+        pool mutation (block growth, copy-on-write, the table feed)
+        plus snapshotted feeds. Returns an opaque handle for
+        :meth:`step_run`, or None with nothing active.
+
+        The split is a thread-safety contract, not a convenience: the
+        scheduler's step-timeout path runs the device call on a
+        worker thread it may LEAK past the timeout. The dense layout
+        tolerates that (a leaked step touches only device state and
+        per-slot numpy scalars), but allocator refcounts would not —
+        so they are only ever touched here, on the caller/dispatcher
+        thread, and a wedged worker can never race retire()/close()
+        on the pool books.
+
+        One caveat: a copy-on-write divergence runs the (rare,
+        per-divergence) block-copy program here too — the table swap
+        is only valid once the copy succeeded, so the two cannot be
+        split across threads. That device call therefore shares
+        ``admit()``'s exposure, not ``step()``'s: like every prefill,
+        it runs unbounded on the dispatcher (the step timeout has
+        always bounded only the per-token decode call)."""
         act = np.flatnonzero(self.active)
         if act.size == 0:
-            return {}
+            return None
         if (self.lengths[act] >= self.max_pos).any():
             over = [int(s) for s in act
                     if self.lengths[s] >= self.max_pos]
             raise RuntimeError(
                 "slots %s are at cache capacity %d — retire before "
                 "stepping" % (over, self.max_pos))
+        if self.paged:
+            return self._prepare_paged(act)
         f_tok, f_pos = self.spec.decode_feeds
+        feed = {f_tok: self.last_token.reshape(-1, 1).copy(),
+                f_pos: self.lengths.astype(np.int32)}
+        return (act, frozenset(), feed)
+
+    def _prepare_paged(self, act):
+        """Paged phase 1: grow/copy-on-write each active slot's write
+        block and build the table feed. Inactive and pool-starved
+        slots get all-dead table rows, so their device writes DROP —
+        a slot can never scribble on blocks it does not own."""
+        from .paged_cache import PoolExhausted
+        bs = self.spec.block_size
+        self._starved.clear()   # a retire may have freed blocks since
+        for s in act:
+            s = int(s)
+            pos = int(self.lengths[s])
+            tbl = self.tables[s]
+            try:
+                if pos // bs == len(tbl):
+                    tbl.append(self._alloc_block())
+                else:
+                    # writing into a block a sharer or the prefix
+                    # index also holds: diverge onto a private copy
+                    self._ensure_writable(tbl, pos // bs)
+            except PoolExhausted:
+                self._starved.add(s)
+        nb = self.pool.num_blocks
+        tab = np.full((self.spec.slots, self.spec.max_blocks), nb,
+                      np.int32)
+        for s in act:
+            s = int(s)
+            if s in self._starved:
+                continue
+            tbl = self.tables[s]
+            tab[s, :len(tbl)] = tbl
+        f_tok, f_pos, f_tab = self.spec.decode_feeds
+        feed = {f_tok: self.last_token.reshape(-1, 1).copy(),
+                f_pos: self.lengths.astype(np.int32),
+                f_tab: tab}
+        return (act, frozenset(self._starved), feed)
+
+    def step_run(self, prepared):
+        """Phase 2 of a decode step: the device call plus result
+        application. Touches no allocator state — safe to execute on
+        the scheduler's bounded (leakable) worker thread; the feeds
+        and starved-set were snapshotted at prepare time."""
+        act, starved, feed = prepared
         with _tracing.span("generationStep",
                            active=int(act.size)):
             outs = self.exe.run(
-                self.spec.decode_program,
-                feed={f_tok: self.last_token.reshape(-1, 1),
-                      f_pos: self.lengths.astype(np.int32)},
+                self.spec.decode_program, feed=feed,
                 fetch_list=[self.spec.decode_fetch], scope=self.scope)
         nxt = np.asarray(outs[0]).reshape(-1)
         result = {}
         for s in act:
             s = int(s)
+            if s in starved:
+                continue
             self.lengths[s] += 1
             self.last_token[s] = int(nxt[s])
             result[s] = int(nxt[s])
@@ -382,10 +756,16 @@ class GenerationSession:
     def retire(self, slot):
         """Free a slot mid-flight. The cache rows are left as-is — the
         next prefill into this slot overwrites them, and the per-slot
-        length mask keeps them unattendable meanwhile."""
+        length mask keeps them unattendable meanwhile. Paged: every
+        block reference the slot's table held is returned to the pool
+        (a block shared with the prefix index survives as cached
+        prompt state; exclusive blocks free immediately)."""
         self.active[slot] = False
         self.lengths[slot] = 0
         self.last_token[slot] = 0
+        if self.paged:
+            self._release_table(slot)
+            self._starved.discard(slot)
 
     def generate(self, prompt, max_new_tokens=None, eos_id=None):
         """Synchronous single-sequence convenience (tests/probes): the
@@ -401,7 +781,10 @@ class GenerationSession:
         tokens = [first]
         try:
             while tokens[-1] != eos and len(tokens) < limit:
-                tokens.append(self.step()[slot])
+                nxt = self.step()
+                if slot not in nxt:
+                    break  # paged pool exhausted: finish at length
+                tokens.append(nxt[slot])
         finally:
             self.retire(slot)
         if tokens and tokens[-1] == eos:
@@ -708,12 +1091,21 @@ class GenerationScheduler:
         tokens already generated), so its length — and therefore its
         prompt bucket, possibly a larger one than the original
         admission used — and its REMAINING budget are what must fit.
-        For a fresh item both reduce to the original check."""
+        For a fresh item both reduce to the original check.
+
+        Paged sessions with the prefix cache armed get one more
+        chance: when the FULL journal outgrew every bucket, a cached
+        prefix may shrink the actual prefill window back under one
+        (``window_fits``, side-effect-free) — dense sessions return
+        the exact old verdict through the same short-circuit."""
         n = item.prompt.size + len(item.tokens)
         need = max(1, item.max_new - len(item.tokens)) \
             if item.explicit_budget else 1
-        return sess.prompt_bucket(n) is not None and \
-            sess.max_pos - n + 1 >= need
+        if sess.max_pos - n + 1 < need or \
+                not sess.storable(n + need - 1):
+            return False
+        return sess.prompt_bucket(n) is not None or \
+            sess.window_fits(item.history())
 
     def _is_wedged(self, si):
         """True while session ``si``'s timed-out step worker is still
@@ -741,7 +1133,9 @@ class GenerationScheduler:
         candidates = [i for i, s in enumerate(self.sessions)
                       if i not in self._rebuilding
                       and not self._is_wedged(i)
-                      and s.free_slots() and self._fits(s, item)]
+                      and s.free_slots() and self._fits(s, item)
+                      and s.admit_ok(item.prompt.size
+                                     + len(item.tokens))]
         if item.failed_on:
             # a session this request already failed on is the LAST
             # resort, breaker state notwithstanding: its breaker may
@@ -1021,13 +1415,16 @@ class GenerationScheduler:
         self._update_occupancy()
         return True
 
-    def _step_session(self, si, sess):
+    def _step_session(self, si, sess, prepared=None):
         """One session's decode step plus its fault hooks — shared by
         the inline path and the bounded worker, so injected faults
         (including a wedge callback) land inside whatever bounds the
-        step."""
+        step. ``prepared`` carries a host-side step_prepare() handle
+        when the caller already ran phase 1 (the bounded path)."""
         _faults.fire_point("generation_session_wedge", index=si)
         _faults.fire_point("generation_step_fail", index=si)
+        if prepared is not None:
+            return sess.step_run(prepared)
         return sess.step()
 
     def _step_timed(self, si, sess):
@@ -1036,10 +1433,21 @@ class GenerationScheduler:
         and marks the session wedged — its stuck worker is leaked and
         CAPPED at one: the wedge marker keeps the session out of
         placement and stepping until the thread finishes, so retries
-        can't stack blocked threads behind a dead device call."""
+        can't stack blocked threads behind a dead device call.
+
+        The session's step_prepare() phase — which on the paged
+        layout mutates the block-pool books — runs HERE on the
+        dispatcher thread, before the worker: a worker leaked past
+        its timeout only ever executes the device call plus per-slot
+        scalar advances, never allocator mutation, so it cannot race
+        the dispatcher's retire()/close() on the pool accounting."""
+        prepared = sess.step_prepare()
+        if prepared is None:
+            return {}
         try:
             return _sres.run_bounded(
-                lambda: self._step_session(si, sess), self.step_timeout,
+                lambda: self._step_session(si, sess, prepared),
+                self.step_timeout,
                 name="generation-step-%d" % si)
         except _sres.ServingTimeoutError as err:
             pending = getattr(err, "pending", None)
@@ -1120,13 +1528,51 @@ class GenerationScheduler:
                 breaker.record_success()
                 self._trial_failures[si] = 0
             _STEPS.inc()
-            _TOKENS.inc(len(mine))
             now_pc = time.perf_counter()
+            advanced = 0
             for slot, it in mine:
+                if slot not in toks:
+                    # paged pool exhausted for this sequence (no
+                    # allocatable block even after eviction): it
+                    # cannot grow HERE. Dense sessions never omit an
+                    # active slot, so this branch costs them nothing.
+                    sess.retire(slot)
+                    del self._active[(si, slot)]
+                    self._update_occupancy()
+                    if self.replay_attempts and it.explicit_budget \
+                            and len(it.tokens) < it.max_new and \
+                            it.replays < self.replay_attempts:
+                        # preemption, not truncation: the journal
+                        # re-queues and resumes BIT-identically once
+                        # blocks free (admit_ok parks it meanwhile) —
+                        # possibly on a less contended session, which
+                        # placement prefers via failed_on. Only an
+                        # exhausted replay budget falls through to
+                        # the capacity finish below.
+                        from .paged_cache import PoolExhausted
+                        it.failed_on.add(si)
+                        _RETIRED.labels(reason="preempted").inc()
+                        self._requeue_for_replay(
+                            [it], PoolExhausted(
+                                "session %d pool exhausted after %d "
+                                "tokens" % (si, len(it.tokens))))
+                        continue
+                    # implicit budgets asked for "as much as fits":
+                    # finishing at the current length IS the
+                    # contract — the 'capacity' retirement, reached
+                    # through pool bytes instead of the position
+                    # table
+                    _RETIRED.labels(reason="capacity").inc()
+                    _REQUEST_SECONDS.observe(now_pc - it.t_submit)
+                    _resolve(it.future,
+                             result=np.asarray(it.tokens, np.int64))
+                    continue
+                advanced += 1
                 it.tokens.append(toks[slot])
                 _INTER_TOKEN_SECONDS.observe(now_pc - it.t_last)
                 it.t_last = now_pc
                 self._finish_if_done(it)
+            _TOKENS.inc(advanced)
 
     # -- session rebuild -------------------------------------------------
     def _maybe_rebuild(self, si, force=False):
@@ -1187,14 +1633,27 @@ class GenerationScheduler:
                 # warm EVERY prompt bucket plus the decode program:
                 # the hand-over must not leave a bucket whose first
                 # live (or replay-promoted) request pays an XLA
-                # compile stall on the dispatcher thread
-                for bucket in spec.prompt_buckets:
-                    n = max(1, min(int(bucket), new.max_pos))
-                    slot, _ = new.admit([spec.bos_id] * n)
+                # compile stall on the dispatcher thread. The prefix
+                # index is detached for the warmups: otherwise a
+                # later bucket's warm prompt matches an earlier one's
+                # cached prefix, the SUFFIX picks a smaller program,
+                # and the large bucket never actually compiles (and
+                # warm-junk tokens would stay pinned in the index).
+                prefix, new.prefix = new.prefix, None
+                try:
+                    for bucket in spec.prompt_buckets:
+                        n = max(1, min(int(bucket), new.max_pos))
+                        slot, _ = new.admit([spec.bos_id] * n)
+                        new.retire(slot)
+                    slot, _ = new.admit([spec.bos_id])
+                    new.step()
                     new.retire(slot)
-                slot, _ = new.admit([spec.bos_id])
-                new.step()
-                new.retire(slot)
+                    if new.paged and spec.copy_program is not None:
+                        # the COW program too (block 0 onto itself is
+                        # a harmless identity copy)
+                        new._copy_block(0, 0)
+                finally:
+                    new.prefix = prefix
             except BaseException:
                 if new is not None:
                     try:

@@ -1,0 +1,375 @@
+"""Paged KV-cache bookkeeping: the block-pool allocator and the
+content-hashed prefix index behind ``generation_paged_kv``.
+
+The dense PR-8 layout gives every sequence a full worst-case cache row
+([slots, cache_len, d_model] per layer), so a 64-token chat pins the
+same HBM as a 2048-token document and concurrency is capped by the most
+pessimistic bucket. The paged layout (the PagedAttention insight)
+stores each layer's K/V as ONE [num_blocks, block_size, d_model] pool;
+a sequence owns a host-side *block table* — the list of physical block
+ids backing its logical positions — and pins only ``ceil(len /
+block_size)`` blocks, so concurrency becomes "pool bytes / live
+tokens".
+
+Two host-side objects, both single-threaded by contract (the
+scheduler's dispatcher thread is the only caller, like the session):
+
+* :class:`BlockPool` — free-list + per-block refcounts. A block with
+  refcount 1 is exclusively owned and writable in place; refcount > 1
+  means it is shared (another sequence, or the prefix index's pin) and
+  a writer must copy-on-write first. ``check_invariant`` cross-checks
+  the refcounts against every live table + the index pins — a leaked
+  block is a test failure, not a slow OOM.
+* :class:`PrefixIndex` — RadixAttention-style prompt caching at block
+  granularity: prefill output blocks are registered under a running
+  content hash of their token chunks (the chain hash makes a block's
+  identity include its full left context), full-block hits are shared
+  read-only across sequences via pool refcounts, and a partial tail
+  block is shared up to the longest common token prefix (the sharer
+  copies-on-write before extending it). Registered blocks hold one
+  index pin each, so prompt K/V survives ``retire()`` and the next
+  admission with the same prefix re-prefills only its unshared suffix;
+  under pool pressure, pin-only (no live sequence) entries are evicted
+  LRU.
+
+Metrics (always-on, the serving discipline):
+``paddle_generation_prefix_hits_total`` / ``_prefix_misses_total``
+(admissions with/without a shared prefix),
+``_prefix_shared_tokens_total`` (prompt tokens NOT re-prefilled),
+``_kv_block_cows_total`` (copy-on-write block copies),
+``_kv_pool_evictions_total`` (prefix blocks reclaimed under pressure),
+``_kv_blocks_in_use`` (gauge per pool).
+"""
+
+import collections
+import hashlib
+import itertools
+
+import numpy as np
+
+from ..observability import metrics as _metrics
+
+__all__ = ["BlockPool", "PrefixIndex", "PoolExhausted"]
+
+PREFIX_HITS = _metrics.REGISTRY.counter(
+    "paddle_generation_prefix_hits_total",
+    "Admissions that reused at least one cached prefix block")
+PREFIX_MISSES = _metrics.REGISTRY.counter(
+    "paddle_generation_prefix_misses_total",
+    "Admissions that found no cached prefix block")
+PREFIX_SHARED_TOKENS = _metrics.REGISTRY.counter(
+    "paddle_generation_prefix_shared_tokens_total",
+    "Prompt tokens served from cached prefix blocks instead of being "
+    "re-prefilled")
+BLOCK_COWS = _metrics.REGISTRY.counter(
+    "paddle_generation_kv_block_cows_total",
+    "Copy-on-write block copies (a sequence wrote into a shared "
+    "block)")
+POOL_EVICTIONS = _metrics.REGISTRY.counter(
+    "paddle_generation_kv_pool_evictions_total",
+    "Cached prefix blocks reclaimed under pool pressure (LRU, "
+    "pin-only entries)")
+BLOCKS_IN_USE = _metrics.REGISTRY.gauge(
+    "paddle_generation_kv_blocks_in_use",
+    "Referenced blocks in one session's pool (labelled per pool — "
+    "sessions side by side must not overwrite each other)",
+    labelnames=("pool",))
+
+_POOL_SEQ = itertools.count()
+
+
+class PoolExhausted(RuntimeError):
+    """No free block and nothing evictable — the pool is at live
+    capacity. Admission gates on ``admit_ok`` so clients normally
+    never see this; mid-decode it means the growing sequence must
+    finish at its current length (retired with reason 'capacity')."""
+
+
+class BlockPool:
+    """Fixed-size block allocator over one session's K/V pools.
+
+    One block id indexes the same row range of EVERY per-layer K and V
+    pool (all layers write the same logical positions), so the
+    allocator is per-session, not per-layer. Refcounts, not ownership
+    lists: a sequence's table holds one ref per entry, the prefix
+    index holds one pin per registered block, and a block returns to
+    the free list exactly when its count reaches zero.
+    """
+
+    def __init__(self, num_blocks, block_size):
+        if num_blocks < 1 or block_size < 1:
+            raise ValueError("need num_blocks >= 1 and block_size >= 1,"
+                             " got %r / %r" % (num_blocks, block_size))
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free = collections.deque(range(self.num_blocks))
+        self._ref = [0] * self.num_blocks
+        self._label = "p%d" % next(_POOL_SEQ)
+        self._gauge = BLOCKS_IN_USE.labels(pool=self._label)
+        self._gauge.set(0)
+
+    # -- accounting ------------------------------------------------------
+    def free_count(self):
+        return len(self._free)
+
+    def used_count(self):
+        return self.num_blocks - len(self._free)
+
+    def refcount(self, block):
+        return self._ref[block]
+
+    def _update_gauge(self):
+        self._gauge.set(self.used_count())
+
+    # -- lifecycle -------------------------------------------------------
+    def alloc(self):
+        """One fresh block with refcount 1 (the caller's)."""
+        if not self._free:
+            raise PoolExhausted(
+                "all %d blocks referenced (%d-row blocks)"
+                % (self.num_blocks, self.block_size))
+        block = self._free.popleft()
+        self._ref[block] = 1
+        self._update_gauge()
+        return block
+
+    def incref(self, block):
+        if self._ref[block] < 1:
+            raise RuntimeError("incref on free block %d" % block)
+        self._ref[block] += 1
+
+    def decref(self, block):
+        """Drop one reference; returns True when the block was freed."""
+        if self._ref[block] < 1:
+            raise RuntimeError("decref on free block %d" % block)
+        self._ref[block] -= 1
+        if self._ref[block] == 0:
+            self._free.append(block)
+            self._update_gauge()
+            return True
+        return False
+
+    def close(self):
+        """Retire this pool's gauge child (registry label hygiene on
+        session teardown, the breaker-gauge discipline)."""
+        BLOCKS_IN_USE.remove(pool=self._label)
+
+    def check_invariant(self, tables, index=None):
+        """Assert the pool books balance: every block's refcount equals
+        the references the live ``tables`` (iterable of block-id lists)
+        plus the ``index`` pins actually hold, free blocks carry zero
+        references, and free + referenced covers the whole pool.
+        Raises AssertionError with the discrepancy — tests assert this
+        after retire/close/failover so a leaked block is a loud
+        failure, not a slow OOM."""
+        want = collections.Counter()
+        for table in tables:
+            want.update(int(b) for b in table)
+        if index is not None:
+            want.update(index.pinned_blocks())
+        free = set(self._free)
+        assert len(free) == len(self._free), \
+            "free list holds duplicates: %r" % (self._free,)
+        for block in range(self.num_blocks):
+            assert self._ref[block] == want[block], (
+                "block %d refcount %d but %d live references "
+                "(tables + index pins)"
+                % (block, self._ref[block], want[block]))
+            assert (self._ref[block] == 0) == (block in free), (
+                "block %d refcount %d vs free-list membership %s"
+                % (block, self._ref[block], block in free))
+        assert len(free) + sum(1 for r in self._ref if r > 0) == \
+            self.num_blocks
+
+
+def _chain_digest(parent, chunk):
+    """Content hash of one block-size token chunk, chained through its
+    left context: the same tokens after a different prefix hash
+    differently, so a block is only ever shared between sequences whose
+    ENTIRE history up to that block matches."""
+    h = hashlib.blake2b(parent, digest_size=16)
+    h.update(np.ascontiguousarray(chunk, dtype=np.int64).tobytes())
+    return h.digest()
+
+
+class PrefixIndex:
+    """Block-granular prompt cache over one :class:`BlockPool`.
+
+    * ``match(tokens)`` — longest cached prefix: full-chunk chain-hash
+      hits first, then the registered partial tail with the longest
+      common token prefix. Returns ``(n_tokens, [block ids])`` without
+      taking references (the admitting caller increfs what it keeps).
+    * ``register(tokens, table)`` — after a prefill wrote the blocks,
+      publish every full chunk (and the partial tail) of ``tokens``;
+      newly registered blocks get one index pin (incref) so they
+      outlive the sequence.
+    * ``evict_one()`` — reclaim the LRU entry whose block no live
+      sequence references (refcount == the pin alone); the allocator
+      calls this under pressure before giving up.
+    """
+
+    def __init__(self, pool):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self._full = {}        # chain digest -> block id
+        self._tails = {}       # chain digest -> {token tuple: block id}
+        # LRU over every registered entry: key -> ("full", digest) or
+        # ("tail", digest, tokens); move_to_end on every hit
+        self._lru = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.shared_tokens = 0
+
+    def __len__(self):
+        return len(self._lru)
+
+    def pinned_blocks(self):
+        """Every block currently holding an index pin (one count per
+        registered entry) — the invariant checker's view."""
+        out = [b for b in self._full.values()]
+        for tails in self._tails.values():
+            out.extend(tails.values())
+        return out
+
+    def _touch(self, key):
+        self._lru[key] = True
+        self._lru.move_to_end(key)
+
+    # -- lookup ----------------------------------------------------------
+    def _walk(self, tokens, touch):
+        """Longest cached prefix walk -> (n_matched, blocks). With
+        ``touch`` the hit entries refresh their LRU position; without,
+        the walk is completely side-effect-free (the placement probe's
+        contract — a capacity poll must not rewrite eviction order)."""
+        tokens = np.asarray(tokens, np.int64).reshape(-1)
+        bs = self.block_size
+        digest = b""
+        blocks = []
+        i = 0
+        while (i + 1) * bs <= tokens.size:
+            nxt = _chain_digest(digest, tokens[i * bs:(i + 1) * bs])
+            block = self._full.get(nxt)
+            if block is None:
+                break
+            digest = nxt
+            blocks.append(block)
+            if touch:
+                self._touch(("full", nxt))
+            i += 1
+        matched = i * bs
+        # partial tail: longest common token prefix with any tail
+        # registered under this chain position (>= 1 token shares the
+        # block's leading rows; the sharer copies-on-write before
+        # writing past them)
+        rest = tuple(int(t) for t in tokens[matched:matched + bs])
+        best_m, best_blk, best_key = 0, None, None
+        for tail, block in self._tails.get(digest, {}).items():
+            m = 0
+            for a, b in zip(tail, rest):
+                if a != b:
+                    break
+                m += 1
+            if m > best_m:
+                best_m, best_blk = m, block
+                best_key = ("tail", digest, tail)
+        if best_blk is not None:
+            blocks.append(best_blk)
+            matched += best_m
+            if touch:
+                self._touch(best_key)
+        return matched, blocks
+
+    def peek(self, tokens):
+        """Matched-prefix LENGTH only, with no side effects at all (no
+        counters, no LRU touch): what scheduler placement consults to
+        decide whether a long replay journal still fits a prompt
+        bucket once its cached prefix is subtracted."""
+        matched, _ = self._walk(tokens, touch=False)
+        return matched
+
+    def match(self, tokens):
+        """Longest cached prefix of ``tokens`` -> (n_matched, blocks).
+        The caller caps ``tokens`` (generation always re-prefills at
+        least the final prompt token — logits come from hidden states,
+        which are not cached). No references are taken here."""
+        matched, blocks = self._walk(tokens, touch=True)
+        if matched:
+            self.hits += 1
+            self.shared_tokens += matched
+            PREFIX_HITS.inc()
+            PREFIX_SHARED_TOKENS.inc(matched)
+        else:
+            self.misses += 1
+            PREFIX_MISSES.inc()
+        return matched, blocks
+
+    # -- registration ----------------------------------------------------
+    def register(self, tokens, table):
+        """Publish the prompt ``tokens`` whose K/V rows live in
+        ``table`` (block ids covering positions [0, len(tokens))).
+        Chunks already registered are left as-is (the matching path
+        shares the very blocks in ``table``); new entries pin their
+        block."""
+        tokens = np.asarray(tokens, np.int64).reshape(-1)
+        bs = self.block_size
+        digest = b""
+        nfull = tokens.size // bs
+        for i in range(min(nfull, len(table))):
+            digest = _chain_digest(digest, tokens[i * bs:(i + 1) * bs])
+            if digest not in self._full:
+                self._full[digest] = table[i]
+                self.pool.incref(table[i])
+                self._lru[("full", digest)] = True
+            self._touch(("full", digest))
+        tail = tuple(int(t) for t in tokens[nfull * bs:])
+        if tail and len(table) > nfull:
+            tails = self._tails.setdefault(digest, {})
+            if tail not in tails:
+                tails[tail] = table[nfull]
+                self.pool.incref(table[nfull])
+                self._lru[("tail", digest, tail)] = True
+            self._touch(("tail", digest, tail))
+
+    # -- eviction --------------------------------------------------------
+    def _drop(self, key):
+        if key[0] == "full":
+            block = self._full.pop(key[1])
+        else:
+            tails = self._tails[key[1]]
+            block = tails.pop(key[2])
+            if not tails:
+                del self._tails[key[1]]
+        del self._lru[key]
+        self.pool.decref(block)
+        return block
+
+    def evictable_count(self):
+        """Entries whose block only the index keeps alive — what
+        ``admit_ok`` may count as reclaimable capacity."""
+        return sum(1 for b in self.pinned_blocks()
+                   if self.pool.refcount(b) == 1)
+
+    def evict_one(self):
+        """Reclaim the LRU pin-only entry; True when a block was
+        freed. Entries whose block a live sequence still references
+        are skipped (dropping the pin would free nothing now and
+        forfeit the share)."""
+        for key in list(self._lru):
+            block = (self._full.get(key[1]) if key[0] == "full"
+                     else self._tails.get(key[1], {}).get(key[2]))
+            if block is not None and self.pool.refcount(block) == 1:
+                self._drop(key)
+                POOL_EVICTIONS.inc()
+                return True
+        return False
+
+    def clear(self):
+        """Unpin everything (session close): every registered block
+        drops its index reference."""
+        for key in list(self._lru):
+            self._drop(key)
+
+    def stats(self):
+        return {"hits": self.hits, "misses": self.misses,
+                "shared_tokens": self.shared_tokens,
+                "entries": len(self._lru)}

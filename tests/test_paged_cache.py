@@ -1,0 +1,879 @@
+"""Paged KV cache with prefix reuse: block-pool allocator accounting,
+paged op/kernel correctness, paged-vs-dense greedy token parity,
+copy-on-write divergence isolation, shared-prefix suffix-only prefill,
+pool-exhaustion capacity retirement, PR-9 failover over the paged
+pool, and the fixed-budget concurrency win."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as ptpu
+from paddle_tpu import layers
+from paddle_tpu.models.transformer import (transformer_lm,
+                                           transformer_lm_session)
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving import (BlockPool, GenerationScheduler,
+                                GenerationSession, PoolExhausted,
+                                PrefixIndex)
+
+pytestmark = [pytest.mark.generation, pytest.mark.paged]
+
+V, MAXLEN = 29, 12
+KW = dict(d_model=16, num_heads=2, d_ff=32, num_layers=2)
+BOS, EOS = 0, 1
+
+
+@pytest.fixture(autouse=True)
+def _no_flash():
+    prev = ptpu.config.get_flag("flash_attention")
+    ptpu.config.set_flags(flash_attention=False)
+    yield
+    ptpu.config.set_flags(flash_attention=prev)
+
+
+def _lm_scope(seed=7, max_len=MAXLEN):
+    """Randomized LM weights + the train program whose per-position
+    logits are the re-encode oracle (the test_generation idiom)."""
+    with ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            toks = layers.data("toks", shape=[1, max_len],
+                               dtype="int64", append_batch_size=False)
+            lbls = layers.data("lbls", shape=[1, max_len],
+                               dtype="int64", append_batch_size=False)
+            _, logits = transformer_lm(toks, lbls, vocab_size=V,
+                                       is_test=True, **KW)
+    exe = ptpu.Executor()
+    scope = ptpu.Scope()
+    with ptpu.scope_guard(scope):
+        exe.run(startup)
+    rs = np.random.RandomState(seed)
+    for n in sorted(scope.var_names()):
+        cur = np.asarray(scope.find_var(n))
+        scope.set_var(n, rs.standard_normal(cur.shape)
+                      .astype(cur.dtype))
+    return scope, exe, main, logits
+
+
+def _reencode_greedy(exe, main, logits, scope, prompt, eos=EOS,
+                     max_len=MAXLEN):
+    seq = list(prompt)
+    out = []
+    while len(seq) <= max_len:
+        buf = np.zeros((1, max_len), np.int64)
+        buf[0, :len(seq)] = seq
+        lg, = exe.run(main, feed={"toks": buf, "lbls": buf},
+                      fetch_list=[logits], scope=scope)
+        nxt = int(np.argmax(lg[0, len(seq) - 1]))
+        out.append(nxt)
+        seq.append(nxt)
+        if nxt == eos:
+            break
+    if out and out[-1] == eos:
+        out = out[:-1]
+    return out
+
+
+def _paged_session(scope, slots=3, cache_len=16, prompt_buckets=(4, 8),
+                   block_size=4, num_blocks=None, prefix_cache=True):
+    spec = transformer_lm_session(
+        V, max_len=MAXLEN, slots=slots, cache_len=cache_len,
+        prompt_buckets=prompt_buckets, bos_id=BOS, eos_id=EOS,
+        paged=True, block_size=block_size, num_blocks=num_blocks,
+        prefix_cache=prefix_cache, **KW)
+    return GenerationSession(spec, scope=scope)
+
+
+def _dense_session(scope, slots=3, cache_len=16, prompt_buckets=(4, 8)):
+    spec = transformer_lm_session(
+        V, max_len=MAXLEN, slots=slots, cache_len=cache_len,
+        prompt_buckets=prompt_buckets, bos_id=BOS, eos_id=EOS, **KW)
+    return GenerationSession(spec, scope=scope)
+
+
+# -- block-pool allocator --------------------------------------------------
+
+class TestBlockPool:
+    def test_alloc_refcount_free_cycle(self):
+        pool = BlockPool(4, 8)
+        a = pool.alloc()
+        b = pool.alloc()
+        assert pool.used_count() == 2 and pool.free_count() == 2
+        pool.incref(a)
+        assert not pool.decref(a)      # still referenced
+        assert pool.decref(a)          # now freed
+        assert pool.free_count() == 3
+        assert pool.decref(b)
+        assert pool.free_count() == 4
+        pool.check_invariant([])
+
+    def test_exhaustion_raises(self):
+        pool = BlockPool(2, 4)
+        pool.alloc()
+        pool.alloc()
+        with pytest.raises(PoolExhausted):
+            pool.alloc()
+
+    def test_double_free_is_loud(self):
+        pool = BlockPool(2, 4)
+        a = pool.alloc()
+        pool.decref(a)
+        with pytest.raises(RuntimeError):
+            pool.decref(a)
+
+    def test_invariant_catches_leak(self):
+        pool = BlockPool(3, 4)
+        a = pool.alloc()
+        # a table that lost the reference: the invariant must fail
+        with pytest.raises(AssertionError):
+            pool.check_invariant([[]])
+        pool.check_invariant([[a]])    # balanced books pass
+
+
+class TestPrefixIndex:
+    def test_full_chunk_chain_match(self):
+        pool = BlockPool(8, 4)
+        idx = PrefixIndex(pool)
+        toks = np.arange(10, 20)       # 10 tokens, bs 4
+        table = [pool.alloc(), pool.alloc(), pool.alloc()]
+        idx.register(toks, table)
+        # full chunks + exact tail prefix
+        m, blocks = idx.match(toks)
+        assert m == 10 and blocks == table
+        # diverging second chunk: only the first block matches
+        other = np.concatenate([toks[:4], [99, 98, 97, 96]])
+        m, blocks = idx.match(other)
+        assert m == 4 and blocks == table[:1]
+        # same tokens after a DIFFERENT first chunk: chain hash
+        # refuses (context is part of a block's identity)
+        shifted = np.concatenate([[5, 5, 5, 5], toks[4:8]])
+        m, blocks = idx.match(shifted)
+        assert m == 0 and blocks == []
+
+    def test_partial_tail_longest_common_prefix(self):
+        pool = BlockPool(8, 4)
+        idx = PrefixIndex(pool)
+        toks = np.asarray([1, 2, 3, 4, 7, 8, 9])   # tail (7, 8, 9)
+        table = [pool.alloc(), pool.alloc()]
+        idx.register(toks, table)
+        m, blocks = idx.match(np.asarray([1, 2, 3, 4, 7, 8, 5, 5]))
+        assert m == 6 and blocks == table        # 4 full + 2 of tail
+        m, blocks = idx.match(np.asarray([1, 2, 3, 4, 5]))
+        assert m == 4 and blocks == table[:1]    # tail diverges at 0
+
+    def test_eviction_frees_only_pin_only_blocks(self):
+        pool = BlockPool(2, 4)
+        idx = PrefixIndex(pool)
+        toks = np.arange(8)
+        table = [pool.alloc(), pool.alloc()]
+        idx.register(toks, table)      # both pinned, refcount 2
+        assert idx.evictable_count() == 0
+        assert not idx.evict_one()     # live references: nothing evictable
+        pool.decref(table[0])          # sequence releases block 0
+        assert idx.evictable_count() == 1
+        assert idx.evict_one()
+        assert pool.free_count() == 1
+        pool.check_invariant([[table[1]]], idx)
+
+
+# -- paged device ops ------------------------------------------------------
+
+class TestPagedOps:
+    def _run(self, build, feeds, cache_shape):
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            build(main)
+        scope = ptpu.Scope()
+        scope.set_var("pool", jnp.zeros(cache_shape, jnp.float32))
+        ptpu.Executor().run(main, feed=feeds, fetch_list=[],
+                            scope=scope)
+        return np.asarray(scope.find_var("pool"))
+
+    def test_write_paged_scatters_through_table_and_drops_padding(self):
+        NB, BS, D = 5, 4, 3
+        rs = np.random.RandomState(0)
+        newv = rs.randn(1, 6, D).astype("float32")
+
+        def build(main):
+            block = main.global_block()
+            block.create_var(name="pool", shape=(NB, BS, D),
+                             persistable=True, stop_gradient=True)
+            new = layers.data("new", shape=[1, 6, D],
+                              append_batch_size=False)
+            tab = layers.data("tab", shape=[3], dtype="int32",
+                              append_batch_size=False)
+            hist = layers.data("hist", shape=[1], dtype="int32",
+                               append_batch_size=False)
+            ln = layers.data("ln", shape=[1], dtype="int32",
+                             append_batch_size=False)
+            block.append_op(type="kv_cache_write_paged",
+                            inputs={"Cache": ["pool"],
+                                    "New": [new.name],
+                                    "Table": [tab.name],
+                                    "Hist": [hist.name],
+                                    "Len": [ln.name]},
+                            outputs={"Out": ["pool"]})
+
+        # hist=2: rows land at logical positions 2..5 through table
+        # [3, 1, NB]; only Len=4 of the 6 window rows are real
+        table = np.asarray([3, 1, NB], np.int32)
+        got = self._run(build, {"new": newv, "tab": table,
+                                "hist": np.asarray([2], np.int32),
+                                "ln": np.asarray([4], np.int32)},
+                        (NB, BS, D))
+        want = np.zeros((NB, BS, D), "float32")
+        for i in range(4):                       # rows 0..3 of window
+            pos = 2 + i
+            want[table[pos // BS], pos % BS] = newv[0, i]
+        np.testing.assert_allclose(got, want)
+
+    def test_append_paged_dead_entry_drops_write(self):
+        NB, BS, D, S = 4, 4, 3, 3
+        rs = np.random.RandomState(1)
+        onev = rs.randn(S, 1, D).astype("float32")
+
+        def build(main):
+            block = main.global_block()
+            block.create_var(name="pool", shape=(NB, BS, D),
+                             persistable=True, stop_gradient=True)
+            one = layers.data("one", shape=[S, 1, D],
+                              append_batch_size=False)
+            pos = layers.data("pos", shape=[S], dtype="int32",
+                              append_batch_size=False)
+            tab = layers.data("tab", shape=[S, 2], dtype="int32",
+                              append_batch_size=False)
+            block.append_op(type="kv_cache_append_paged",
+                            inputs={"Cache": ["pool"],
+                                    "New": [one.name],
+                                    "Pos": [pos.name],
+                                    "Table": [tab.name]},
+                            outputs={"Out": ["pool"]})
+
+        posv = np.asarray([5, 2, 1], np.int32)
+        tabv = np.asarray([[0, 2], [1, 0], [NB, NB]], np.int32)
+        got = self._run(build, {"one": onev, "pos": posv, "tab": tabv},
+                        (NB, BS, D))
+        want = np.zeros((NB, BS, D), "float32")
+        want[2, 1] = onev[0, 0]       # slot 0: pos 5 -> block 2 row 1
+        want[1, 2] = onev[1, 0]       # slot 1: pos 2 -> block 1 row 2
+        # slot 2: dead table entry (NB) -> write dropped entirely
+        np.testing.assert_allclose(got, want)
+
+    def test_block_copy(self):
+        NB, BS, D = 4, 4, 3
+        rs = np.random.RandomState(2)
+        init = rs.randn(NB, BS, D).astype("float32")
+
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            block = main.global_block()
+            block.create_var(name="pool", shape=(NB, BS, D),
+                             persistable=True, stop_gradient=True)
+            src = layers.data("src", shape=[1], dtype="int32",
+                              append_batch_size=False)
+            dst = layers.data("dst", shape=[1], dtype="int32",
+                              append_batch_size=False)
+            block.append_op(type="kv_block_copy",
+                            inputs={"Cache": ["pool"],
+                                    "Src": [src.name],
+                                    "Dst": [dst.name]},
+                            outputs={"Out": ["pool"]})
+        scope = ptpu.Scope()
+        scope.set_var("pool", jnp.asarray(init))
+        ptpu.Executor().run(
+            main, feed={"src": np.asarray([1], np.int32),
+                        "dst": np.asarray([3], np.int32)},
+            fetch_list=[], scope=scope)
+        got = np.asarray(scope.find_var("pool"))
+        want = init.copy()
+        want[3] = init[1]
+        np.testing.assert_allclose(got, want)
+
+
+class TestPagedDecodeKernel:
+    def test_block_gather_kernel_matches_dense_gather(self):
+        """The Pallas block-table-gather kernel streams scattered pool
+        blocks; unreferenced pool blocks are NaN-poisoned so a stray
+        gather (wrong block, dead-block fetch feeding compute) fails
+        loudly instead of averaging in."""
+        from paddle_tpu.ops.pallas_attention import (
+            _decode_paged_reference, decode_attention_paged)
+        rs = np.random.RandomState(0)
+        S, H, HD, NB, BS, MB = 3, 2, 8, 10, 4, 4
+        D = H * HD
+        lengths = np.asarray([1, 9, 16], np.int32)
+        tables = np.full((S, MB), NB, np.int32)
+        pool_k = np.full((NB, BS, D), np.nan, "float32")
+        pool_v = np.full((NB, BS, D), np.nan, "float32")
+        used = iter([7, 0, 3, 2, 9, 5, 1, 4])    # scattered, unordered
+        for s in range(S):
+            for j in range(-(-int(lengths[s]) // BS)):
+                b = next(used)
+                tables[s, j] = b
+                pool_k[b] = rs.randn(BS, D)
+                pool_v[b] = rs.randn(BS, D)
+        q = rs.randn(S, 1, D).astype("float32")
+        out = decode_attention_paged(
+            jnp.asarray(q), jnp.asarray(pool_k), jnp.asarray(pool_v),
+            jnp.asarray(lengths), jnp.asarray(tables), H,
+            interpret=True)
+        assert np.isfinite(np.asarray(out)).all()
+        # reference on pools with the NaNs zeroed (the dense gather
+        # touches masked rows; the kernel must match its live math)
+        ref = _decode_paged_reference(
+            jnp.asarray(q), jnp.asarray(np.nan_to_num(pool_k)),
+            jnp.asarray(np.nan_to_num(pool_v)), jnp.asarray(lengths),
+            jnp.asarray(tables), H)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_dense_gather_reference_equals_contiguous_reference(self):
+        """_decode_paged_reference over a scattered pool == the PR-8
+        _decode_reference over the hand-gathered contiguous cache —
+        the shared-semantics contract that makes paged vs dense
+        token-identical."""
+        from paddle_tpu.ops.pallas_attention import (
+            _decode_paged_reference, _decode_reference)
+        rs = np.random.RandomState(3)
+        S, H, HD, NB, BS, MB = 2, 2, 4, 6, 4, 3
+        D = H * HD
+        C = MB * BS
+        pool_k = rs.randn(NB, BS, D).astype("float32")
+        pool_v = rs.randn(NB, BS, D).astype("float32")
+        tables = np.asarray([[4, 1, 5], [2, 0, 3]], np.int32)
+        lengths = np.asarray([7, 12], np.int32)
+        q = rs.randn(S, 1, D).astype("float32")
+        out = _decode_paged_reference(
+            jnp.asarray(q), jnp.asarray(pool_k), jnp.asarray(pool_v),
+            jnp.asarray(lengths), jnp.asarray(tables), H)
+        k = pool_k[tables].reshape(S, C, D)
+        v = pool_v[tables].reshape(S, C, D)
+        qh = q.reshape(S, H, HD)
+        kh = k.reshape(S, C, H, HD).transpose(0, 2, 1, 3)
+        vh = v.reshape(S, C, H, HD).transpose(0, 2, 1, 3)
+        ref = _decode_reference(
+            jnp.asarray(qh.reshape(S * H, 1, HD)),
+            jnp.asarray(kh.reshape(S * H, C, HD)),
+            jnp.asarray(vh.reshape(S * H, C, HD)),
+            jnp.asarray(np.repeat(lengths, H))).reshape(S, 1, D)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6, rtol=1e-6)
+
+
+# -- paged-vs-dense greedy parity ------------------------------------------
+
+class TestPagedParity:
+    @pytest.mark.parametrize("flash", [False, True])
+    def test_token_identical_to_dense_and_oracle(self, flash):
+        """Acceptance: greedy output token-identical to the dense
+        layout in ALL paths (dense XLA and Pallas), over ragged prompt
+        lengths crossing block boundaries (block_size 4; prompts of
+        1/3/4/5/7 tokens end before, at, and past block edges)."""
+        ptpu.config.set_flags(flash_attention=flash)
+        scope, exe, main, logits = _lm_scope()
+        dense = _dense_session(scope)
+        paged = _paged_session(scope)      # prefix sharing armed
+        prompts = ([BOS], [BOS, 5, 7], [2, 3, 4, 5], [2, 3, 4, 5, 6],
+                   [2, 3, 4, 5, 6, 7, 8])
+        seqs = []
+        for prompt in prompts:
+            want = _reencode_greedy(exe, main, logits, scope, prompt)
+            got_d = [int(t) for t in dense.generate(prompt)]
+            got_p = [int(t) for t in paged.generate(prompt)]
+            assert got_d == want, ("dense", prompt)
+            assert got_p == want, ("paged", prompt)
+            seqs.append(tuple(want))
+        assert len(set(seqs)) > 1          # prompt-dependent outputs
+        paged.check_pool_invariant()
+        paged.close()
+
+    def test_compile_shape_set_stays_closed(self):
+        """One compile per prompt bucket + one decode + one block-copy
+        program — however many admissions, prefix hits, and COWs
+        flow."""
+        scope, exe, main, logits = _lm_scope()
+        sess = _paged_session(scope, prompt_buckets=(4, 8))
+        sess.generate([BOS], max_new_tokens=4)
+        sess.generate([2, 3, 4, 5, 6], max_new_tokens=5)   # bucket 8
+        sess.generate([2, 3, 4, 5, 6], max_new_tokens=5)   # prefix hit
+        stats = sess.compile_stats()
+        sess.generate([4, 5, 6, 7], max_new_tokens=5)
+        s1, _ = sess.admit([2, 3])
+        sess.step()
+        sess.retire(s1)
+        assert sess.compile_stats() == stats
+        # <= 2 prefill buckets + 1 decode + 1 copy program
+        assert stats["compiles"] <= 4
+        sess.close()
+
+
+# -- prefix reuse ----------------------------------------------------------
+
+class TestPrefixReuse:
+    def test_shared_prefix_prefills_once(self):
+        """Acceptance: a shared-prefix batch prefills the common
+        prefix exactly once — proven by the per-admission prefill log
+        (bucket, hist, window): later admissions re-prefill ONLY the
+        unshared suffix, and the full-prompt bucket is never used
+        again."""
+        scope, exe, main, logits = _lm_scope()
+        sess = _paged_session(scope, slots=3,
+                              prompt_buckets=(4, 8, 12),
+                              num_blocks=24)
+        system = [2, 3, 4, 5, 6, 7, 8, 9]          # two full blocks
+        users = ([10], [11], [12])
+        for u in users:
+            want = _reencode_greedy(exe, main, logits, scope,
+                                    system + u)
+            got = [int(t) for t in sess.generate(system + u,
+                                                 max_new_tokens=3)]
+            assert got == want[:len(got)], u
+        log = sess.prefill_log
+        assert log[0][1] == 0                      # full first prefill
+        # every later admission: hist covers the shared system
+        # prompt, window is the 1-2 unshared tokens in the SMALL
+        # bucket — the 9-token bucket is never compiled again
+        for bucket, hist, window in log[1:]:
+            assert hist >= 8, log
+            assert window <= 2, log
+            assert bucket == 4, log
+        stats = sess.prefix_stats()
+        assert stats["hits"] == len(users) - 1
+        assert stats["misses"] == 1                # the first admission
+        assert stats["shared_tokens"] >= 8 * (len(users) - 1)
+        sess.check_pool_invariant()
+        sess.close()
+
+    def test_prefix_survives_retire_and_serves_next_admission(self):
+        """Retired sequences free their exclusive blocks; prompt
+        blocks pinned by the index stay cached, so a later identical
+        prompt re-prefills only its tail."""
+        scope, _, _, _ = _lm_scope()
+        sess = _paged_session(scope, num_blocks=16)
+        prompt = [2, 3, 4, 5, 6, 7]
+        sess.generate(prompt, max_new_tokens=4)
+        used_after_retire = sess.pool.used_count()
+        assert used_after_retire > 0        # prompt blocks cached
+        sess.generate(prompt, max_new_tokens=4)
+        _, hist, window = sess.prefill_log[-1]
+        assert hist >= 4 and window <= 2
+        sess.check_pool_invariant()
+        sess.close()
+
+    def test_pool_pressure_evicts_cold_prefix_blocks(self):
+        """A full pool reclaims pin-only (no live sequence) prefix
+        entries LRU instead of refusing admission."""
+        scope, _, _, _ = _lm_scope()
+        sess = _paged_session(scope, slots=2, num_blocks=4)
+        sess.generate([2, 3, 4, 5, 6], max_new_tokens=3)
+        assert sess.pool.used_count() > 0   # cached prompt blocks
+        # a different prompt needing most of the pool: must evict,
+        # not die
+        sess.generate([10, 11, 12, 13, 14], max_new_tokens=3)
+        sess.check_pool_invariant()
+        sess.close()
+
+
+# -- copy-on-write ---------------------------------------------------------
+
+class TestCopyOnWrite:
+    def test_divergence_isolation_under_sharing(self):
+        """Acceptance satellite: two sequences admitted from the SAME
+        prompt share its blocks; both then decode concurrently and
+        MUST NOT see each other's writes — each matches its solo
+        run token for token (COW gives the writer a private copy)."""
+        scope, exe, main, logits = _lm_scope()
+        solo = _reencode_greedy(exe, main, logits, scope, [2, 3, 4, 5, 6])
+        sess = _paged_session(scope, slots=2, num_blocks=20)
+        from paddle_tpu.serving.paged_cache import BLOCK_COWS
+        cows0 = BLOCK_COWS._default().value
+        sA, tA = sess.admit([2, 3, 4, 5, 6])
+        toksA = [tA]
+        toksA.append(sess.step()[sA])          # A decodes alone first
+        sB, tB = sess.admit([2, 3, 4, 5, 6])   # shares A's blocks
+        toksB = [tB]
+        for _ in range(4):
+            step = sess.step()
+            toksA.append(step[sA])
+            toksB.append(step[sB])
+        assert [int(t) for t in toksA[:6]] == solo[:6]
+        assert [int(t) for t in toksB[:5]] == solo[:5]
+        # sharing + diverging really exercised the COW path
+        assert BLOCK_COWS._default().value > cows0
+        stats = sess.prefix_stats()
+        assert stats["shared_tokens"] >= 4
+        sess.retire(sA)
+        sess.retire(sB)
+        sess.check_pool_invariant()
+        sess.close()
+
+    def test_cow_write_does_not_corrupt_cached_prefix(self):
+        """After a sharer diverges (COW + decode writes), the ORIGINAL
+        cached prompt blocks still serve a third admission with the
+        same prompt correctly."""
+        scope, exe, main, logits = _lm_scope()
+        want = _reencode_greedy(exe, main, logits, scope, [2, 3, 4, 5, 6])
+        sess = _paged_session(scope, slots=2, num_blocks=20)
+        sess.generate([2, 3, 4, 5, 6], max_new_tokens=6)
+        sess.generate([2, 3, 4, 5, 6], max_new_tokens=6)  # shares+COWs
+        got = [int(t) for t in sess.generate([2, 3, 4, 5, 6],
+                                             max_new_tokens=6)]
+        assert got == want[:len(got)]
+        sess.check_pool_invariant()
+        sess.close()
+
+
+# -- pool accounting / capacity --------------------------------------------
+
+class TestPoolAccounting:
+    def test_retire_returns_every_block(self):
+        scope, _, _, _ = _lm_scope()
+        sess = _paged_session(scope, slots=3, prefix_cache=False)
+        slots = [sess.admit([2, 3, 4, 5, 6])[0],
+                 sess.admit([7, 8])[0]]
+        for _ in range(3):
+            sess.step()
+        assert sess.pool.used_count() > 0
+        for s in slots:
+            sess.retire(s)
+        sess.check_pool_invariant()
+        # no prefix index: every reference was the sequences' own
+        assert sess.pool.used_count() == 0
+        sess.close()
+
+    def test_close_releases_prefix_pins_too(self):
+        scope, _, _, _ = _lm_scope()
+        sess = _paged_session(scope, slots=2, prefix_cache=True)
+        sess.generate([2, 3, 4, 5, 6], max_new_tokens=3)
+        assert sess.pool.used_count() > 0   # pinned prompt blocks
+        pool = sess.pool
+        sess.close()                        # asserts zero leaked inside
+        assert pool.used_count() == 0
+
+    def test_failed_admission_rolls_back_references(self):
+        scope, _, _, _ = _lm_scope()
+        sess = _paged_session(scope, slots=2, num_blocks=16)
+        before = sess.pool.used_count()
+        with pytest.raises(ValueError):
+            sess.admit([2] * 20)            # exceeds cache capacity
+        assert sess.pool.used_count() == before
+        sess.check_pool_invariant()
+        sess.close()
+
+    def test_pool_exhaustion_finishes_sequence_at_capacity(self):
+        """A sequence that cannot get a growth block is excluded from
+        the step (its write drops on device) and a scheduler finishes
+        it at its current length — the 'capacity' contract via pool
+        bytes."""
+        scope, _, _, _ = _lm_scope()
+        # 2 slots x long budgets over a 3-block pool: one sequence
+        # must starve while the other keeps every block busy
+        sess = _paged_session(scope, slots=2, num_blocks=3,
+                              prefix_cache=False)
+        sched = GenerationScheduler(sess)
+        try:
+            futs = [sched.submit([2, 3], max_new_tokens=8, eos_id=-1),
+                    sched.submit([4, 5], max_new_tokens=8, eos_id=-1)]
+            outs = [f.result(timeout=60) for f in futs]
+        finally:
+            sched.drain()
+        # both resolve (no exception), at least one was cut short by
+        # pool capacity, and nothing leaked
+        assert all(len(o) >= 1 for o in outs)
+        assert any(len(o) < 8 for o in outs), [len(o) for o in outs]
+        sess.check_pool_invariant()
+        assert sess.pool.used_count() == 0
+        sess.close()
+
+    def test_pool_preemption_replays_explicit_budget_in_full(self):
+        """With replay armed, pool starvation is PREEMPTION, not
+        truncation: the starved request re-queues with its journal
+        and resumes once blocks free — the explicit token budget is
+        delivered in full, bit-identical to an uncontended run."""
+        scope, _, _, _ = _lm_scope()
+        solo_sess = _paged_session(scope, slots=2, num_blocks=8,
+                                   prefix_cache=False)
+        solos = {p: [int(t) for t in solo_sess.generate(
+            list(p), max_new_tokens=8, eos_id=-1)]
+            for p in ((2, 3), (4, 5))}
+        solo_sess.close()
+        sess = _paged_session(scope, slots=2, num_blocks=3,
+                              prefix_cache=False)
+        sched = GenerationScheduler(sess, replay_attempts=4)
+        try:
+            futs = {p: sched.submit(list(p), max_new_tokens=8,
+                                    eos_id=-1)
+                    for p in solos}
+            for p, f in futs.items():
+                got = [int(t) for t in f.result(timeout=120)]
+                assert got == solos[p], (p, got)     # full 8 tokens
+        finally:
+            sched.drain()
+        sess.check_pool_invariant()
+        assert sess.pool.used_count() == 0
+        sess.close()
+
+    def test_admit_ok_accepts_history_needing_whole_pool(self):
+        """The COW margin must not make a history that needs exactly
+        the full pool permanently unadmittable (it would park
+        forever): on an idle pool admit_ok says yes."""
+        scope, _, _, _ = _lm_scope()
+        sess = _paged_session(scope, slots=1, num_blocks=2,
+                              prefix_cache=True)
+        assert sess.admit_ok(8)        # 2 blocks = the whole pool
+        sess.close()
+
+    def test_admit_ok_gates_scheduler_placement(self):
+        scope, _, _, _ = _lm_scope()
+        sess = _paged_session(scope, slots=2, num_blocks=2,
+                              prefix_cache=False)
+        # 2 blocks busy -> a 5-token admission (2 blocks) must report
+        # not-ok instead of raising inside the dispatcher
+        s0, _ = sess.admit([2, 3, 4, 5, 6])
+        assert not sess.admit_ok(5)
+        sess.retire(s0)
+        assert sess.admit_ok(5)
+        sess.check_pool_invariant()
+        sess.close()
+
+
+# -- PR-9 failover over the paged pool -------------------------------------
+
+@pytest.mark.chaos
+class TestPagedFailover:
+    def test_replay_bit_identical_with_suffix_only_reprefill(self):
+        """Acceptance satellite: a session fault mid-decode over the
+        paged pool replays onto the healthy session BIT-identically,
+        and because the healthy session already serves the shared
+        prompt, the replay re-prefills only its unshared suffix
+        (journal hist > 0). Both pools balance afterwards."""
+        scope, _, _, _ = _lm_scope()
+        prompt = [2, 3, 4, 5, 6, 7, 8, 9]      # two full blocks
+        s_a = _paged_session(scope, slots=2, num_blocks=24)
+        s_b = _paged_session(scope, slots=2, num_blocks=24)
+        # fault-free baseline from its own session set
+        base_sess = _paged_session(scope, slots=2, num_blocks=24)
+        baseline = [int(t) for t in base_sess.generate(
+            prompt, max_new_tokens=6, eos_id=-1)]
+        base_sess.close()
+        # warm the healthy session's prefix cache with the prompt
+        s_b.generate(prompt, max_new_tokens=1, eos_id=-1)
+        warm_log = len(s_b.prefill_log)
+        sched = GenerationScheduler(
+            [s_a, s_b], breaker_failures=1, breaker_cooldown_ms=10000,
+            replay_attempts=2)
+        try:
+            # persistent step fault on session 0: the request admits
+            # there (lowest index), fails, and must replay onto 1
+            faults.arm("generation_step_fail", at=0, times=None)
+            fut = sched.submit(prompt, max_new_tokens=6, eos_id=-1)
+            got = [int(t) for t in fut.result(timeout=120)]
+        finally:
+            faults.disarm()
+            sched.drain()
+        assert got == baseline                  # bit-identical replay
+        # the replay admission on the healthy session shared the
+        # prompt prefix: its journal prefill carried hist > 0
+        replay_log = s_b.prefill_log[warm_log:]
+        assert replay_log, "replay never reached the healthy session"
+        assert all(hist >= 8 for _, hist, _ in replay_log), replay_log
+        s_a.check_pool_invariant()
+        s_b.check_pool_invariant()
+        s_a.close()
+        s_b.close()
+
+
+@pytest.mark.chaos
+class TestPagedWedge:
+    def test_leaked_step_worker_cannot_corrupt_pool_books(self):
+        """A step wedged past generation_step_timeout_ms leaks its
+        worker thread; on the paged layout that worker must never
+        touch the allocator (step_prepare runs host-side bookkeeping
+        on the dispatcher BEFORE the bounded call), so the pool books
+        balance even while the leaked worker finishes long after the
+        dispatcher retired the slots and replayed the requests."""
+        import time as _time
+        scope, _, _, _ = _lm_scope()
+        s_a = _paged_session(scope, slots=2, num_blocks=24)
+        s_b = _paged_session(scope, slots=2, num_blocks=24)
+        baseline_sess = _paged_session(scope, slots=2, num_blocks=24)
+        prompts = ([2, 3, 4], [5, 6])
+        want = [[int(t) for t in baseline_sess.generate(
+            list(p), max_new_tokens=5, eos_id=-1)] for p in prompts]
+        baseline_sess.close()
+        for s in (s_a, s_b):          # warm: a cold compile would
+            s.generate([BOS], max_new_tokens=2, eos_id=-1)  # trip the
+        sched = GenerationScheduler(                        # timeout
+            [s_a, s_b], replay_attempts=4, breaker_failures=3,
+            breaker_cooldown_ms=60000.0, step_timeout_ms=400.0)
+        try:
+            faults.arm("generation_session_wedge", at=0, times=1,
+                       action="callback",
+                       callback=lambda: _time.sleep(1.5))
+            futs = [sched.submit(list(p), max_new_tokens=5, eos_id=-1)
+                    for p in prompts]
+            got = [[int(t) for t in f.result(timeout=120)]
+                   for f in futs]
+            assert got == want        # replayed onto the healthy one
+            assert sched.session_health()[0] == "open"
+            _time.sleep(1.8)          # let the leaked worker finish
+            s_a.check_pool_invariant()
+            s_b.check_pool_invariant()
+        finally:
+            faults.disarm()
+            sched.drain()
+        s_a.check_pool_invariant()
+        s_b.check_pool_invariant()
+        s_a.close()                   # close asserts zero leaked
+        s_b.close()
+
+
+@pytest.mark.chaos
+class TestPagedRebuild:
+    def test_rebuild_warms_every_bucket_despite_prefix_cache(self):
+        """The background rebuild of a paged session detaches the
+        prefix index during warmup — otherwise a later bucket's warm
+        prompt matches an earlier one's cached prefix and the large
+        prefill program never compiles (a live-traffic stall after
+        hand-over). The rebuilt session must carry compiles for EVERY
+        bucket plus decode plus the COW program, an unpolluted index,
+        and balanced pool books."""
+        import time as _time
+        scope, _, _, _ = _lm_scope()
+        sess = _paged_session(scope, slots=2, prompt_buckets=(4, 8),
+                              num_blocks=24)
+        sched = GenerationScheduler(
+            sess, replay_attempts=10, breaker_failures=1,
+            breaker_cooldown_ms=30.0, rebuild_limit=2)
+        try:
+            # initial failure + two failed cooldown trials = rebuild
+            # trigger; then the "device" heals and the rebuilt
+            # session serves (the dense-rebuild test's recipe)
+            faults.arm("generation_step_fail", at=0, times=3)
+            got = sched.submit([2, 3, 4], max_new_tokens=4,
+                               eos_id=-1).result(timeout=120)
+            assert len(got) == 4
+            deadline = _time.monotonic() + 30
+            while sched.sessions[0] is sess and \
+                    _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            rebuilt = sched.sessions[0]
+            assert rebuilt is not sess, "rebuild never handed over"
+            stats = rebuilt.compile_stats()
+            # 2 prompt buckets + 1 decode + 1 block-copy, all warmed
+            # BEFORE traffic (the live request above reuses them)
+            assert stats["entries"] >= 4, stats
+            # warm prompts must not stay pinned in the prefix index;
+            # only the live request's own registration may remain
+            live_entries = rebuilt.prefix_stats()["entries"]
+            assert live_entries <= 2, live_entries
+            rebuilt.check_pool_invariant()
+        finally:
+            faults.disarm()
+            sched.close()
+
+class TestConcurrencyAtFixedBudget:
+    def test_paged_sustains_2x_dense_sequences(self):
+        """Acceptance: at the SAME cache-byte budget, the paged pool
+        holds >= 2x the concurrent sequences of the dense layout on a
+        mixed-length workload, token-identical throughout."""
+        scope, exe, main, logits = _lm_scope()
+        # dense: 3 slots x 16 rows = 48 rows of budget, 3 sequences max
+        dense = _dense_session(scope, slots=3, cache_len=16)
+        # paged: SAME 48 rows (12 blocks x 4), but 8 decode lanes
+        paged = _paged_session(scope, slots=8, cache_len=16,
+                               block_size=4, num_blocks=12,
+                               prefix_cache=False)
+        rs = np.random.RandomState(0)
+        prompts = [list(rs.randint(2, V, int(n)))
+                   for n in (1, 2, 3, 1, 2, 3, 2, 1)]   # mixed, short
+        # dense admits exactly its slot count
+        admitted_d = 0
+        for p in prompts:
+            try:
+                dense.admit(p)
+                admitted_d += 1
+            except RuntimeError:
+                break
+        # paged admits while blocks last
+        admitted_p, slots_p = 0, []
+        for p in prompts:
+            if not (paged.free_slots() and paged.admit_ok(len(p))):
+                break
+            slots_p.append(paged.admit(p)[0])
+            admitted_p += 1
+        assert admitted_d == 3
+        assert admitted_p >= 2 * admitted_d, (admitted_p, admitted_d)
+        # all paged sequences decode together, matching their solos
+        toks = {s: [] for s in slots_p}
+        for _ in range(2):
+            step = paged.step()
+            for s in slots_p:
+                toks[s].append(step[s])
+        for i, s in enumerate(slots_p):
+            want = _reencode_greedy(exe, main, logits, scope,
+                                    prompts[i], eos=-1)[1:3]
+            assert [int(t) for t in toks[s]] == want, prompts[i]
+        for s in list(paged.active_slots()):
+            paged.retire(s)
+        paged.check_pool_invariant()
+        paged.close()
+
+
+# -- off-by-default guarantee ----------------------------------------------
+
+class TestPagedDefaultOff:
+    def test_flags_exist_with_defaults(self):
+        assert ptpu.config.get_flag("generation_paged_kv") is False
+        assert ptpu.config.get_flag("generation_block_size") == 16
+        assert ptpu.config.get_flag("generation_pool_blocks") == 0
+        assert ptpu.config.get_flag("generation_prefix_cache") is False
+
+    def test_default_spec_is_dense_pr8_layout(self):
+        spec = transformer_lm_session(V, max_len=MAXLEN, slots=2,
+                                      cache_len=16,
+                                      prompt_buckets=(4,), **KW)
+        assert spec.paged is False
+        assert spec.copy_program is None
+        name, shape, _ = spec.cache_vars[0]
+        assert shape == (2, 16, KW["d_model"])       # dense per-slot
+        assert spec.prefill_feeds == ("gen.ptok", "gen.plen",
+                                      "gen.ppos", "gen.slot")
+        assert spec.decode_feeds == ("gen.dtok", "gen.dpos")
+
+    def test_dense_hot_path_consults_no_paged_flag(self, monkeypatch):
+        """The dense session's admit/step never read a paged flag —
+        paged mode costs nothing until a paged spec is built."""
+        scope, _, _, _ = _lm_scope()
+        sess = _dense_session(scope, slots=2, prompt_buckets=(4,))
+        sess.generate([BOS], max_new_tokens=2)       # warm compiles
+        calls = []
+        orig = ptpu.config.get_flag
+
+        def counting(name):
+            calls.append(name)
+            return orig(name)
+
+        monkeypatch.setattr(ptpu.config, "get_flag", counting)
+        slot, _ = sess.admit([BOS])
+        sess.step()
+        sess.retire(slot)
+        assert not [c for c in calls
+                    if c.startswith("generation_paged")
+                    or c in ("generation_block_size",
+                             "generation_pool_blocks",
+                             "generation_prefix_cache")], calls
+
+    def test_rebuild_factory_preserves_paged_geometry(self):
+        spec = transformer_lm_session(
+            V, max_len=MAXLEN, slots=2, cache_len=16,
+            prompt_buckets=(4,), paged=True, block_size=4,
+            num_blocks=10, prefix_cache=True, **KW)
+        fresh = spec.rebuild()
+        assert fresh.paged and fresh.block_size == 4
+        assert fresh.num_blocks == 10 and fresh.prefix_cache
+        # fresh cache namespace: no name collides with the original
+        assert not ({n for n, _, _ in fresh.cache_vars}
+                    & {n for n, _, _ in spec.cache_vars})
