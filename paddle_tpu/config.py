@@ -200,6 +200,38 @@ compile_cache_max_bytes: 0 (default) = the persistent compile cache
   evicting the entry it just published. Evictions are counted in
   ``paddle_deploy_cache_evictions_total``. Only consulted on the
   store path — cache-off means zero flag reads.
+
+request_tracing: if True, arm request-scoped tracing
+  (observability/request_trace.py) and the flight recorder
+  (observability/flight.py): each sampled serving/generation request
+  is minted a TraceContext at submit and typed span events record its
+  whole life — queue wait, prefill (prefix-cache hit length), decode
+  steps, COW copies, failover hops, rebuilds, breaker transitions,
+  deadline expiry, device calls, resolution — retrievable as a span
+  tree via /debug/trace. Off (default): mint() is one attribute read
+  returning None, every event site is a None check, and the serving
+  hot paths keep their flag-check counts and byte-identical behavior.
+  The per-stage latency histograms (paddle_request_*_ms) are
+  always-on regardless, like every serving front-door metric. Synced
+  into module state by the observability config hook — nothing reads
+  this flag per request.
+
+trace_sample_rate: fraction of requests minted a TraceContext while
+  ``request_tracing`` is armed (1.0 = every request). Sampling
+  happens at mint — an unsampled request records no events anywhere
+  (including the flight ring) but keeps its always-on histograms.
+
+telemetry_port: 0 (default) = no introspection server. N = serve
+  live introspection on 127.0.0.1:N (observability/http.py, stdlib
+  http.server on a daemon thread): /metrics (Prometheus text),
+  /healthz (engine/scheduler component health, 200/503),
+  /debug/trace?id= (one request's span tree), /debug/flight (latest
+  flight-recorder bundle). Started/stopped by the config hook when
+  the flag changes; a bind failure logs and never breaks set_flags.
+
+flight_dir: where flight-recorder bundles are dumped (None = default
+  <tempdir>/paddle_tpu_flight). Bundles are bounded to the newest
+  FlightRecorder.max_dumps files; read only at dump time.
 """
 
 import jax
@@ -255,6 +287,14 @@ _flags = {
     "generation_prefix_cache": False,
     # persistent compile cache size cap (core/compile_cache.py)
     "compile_cache_max_bytes": 0,
+    # request-scoped tracing + flight recorder + live introspection
+    # (observability/request_trace.py, flight.py, http.py; synced into
+    # module state by the observability config hook — no serving hot
+    # path reads these per request)
+    "request_tracing": False,
+    "trace_sample_rate": 1.0,
+    "telemetry_port": 0,
+    "flight_dir": None,
 }
 
 # Observers called with the flag dict after every set_flags (the

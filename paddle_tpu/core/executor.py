@@ -34,6 +34,7 @@ from .framework import (Program, Variable, default_main_program,
                         convert_dtype, RNG_STATE_VAR)
 from .scope import global_scope
 from ..observability import metrics as _metrics
+from ..observability import request_trace as _rtrace
 from ..observability import tracing as _tracing
 
 EMPTY_VAR = "@EMPTY@"
@@ -581,6 +582,12 @@ class Executor:
             return_numpy=True, donate_state=True):
         if scope is None:
             scope = global_scope()
+        # request-scoped tracing: a serving layer above may have
+        # activated a request's TraceContext on this thread — the
+        # device call then lands as a span on that request's trace.
+        # One thread-local read; no config flag, no cost when off.
+        _rt_ctx = _rtrace.current()
+        _rt_t0 = time.perf_counter() if _rt_ctx is not None else 0.0
         entry, state_rw, state_ro, feed_arrays = self._prepare(
             program, feed, fetch_list, scope, donate_state)
         from .. import config as _config
@@ -640,6 +647,10 @@ class Executor:
             if bad:
                 raise FloatingPointError(
                     "NaN/Inf detected in op outputs: %s" % ", ".join(bad))
+        if _rt_ctx is not None:
+            _rtrace.event(
+                _rt_ctx, "deviceCall", key=entry.key_id,
+                dur_ms=(time.perf_counter() - _rt_t0) * 1e3)
         return fetches
 
     def as_jax_function(self, program, feed_templates, fetch_list,

@@ -29,7 +29,9 @@ metrics: they fire on rare events, never per step, and an operator
 debugging a flapping job needs them present without re-running armed.
 """
 
+from . import flight  # noqa: F401
 from . import metrics  # noqa: F401
+from . import request_trace  # noqa: F401
 from . import tracing  # noqa: F401
 
 
@@ -39,8 +41,34 @@ def enabled():
     return bool(config.get_flag("telemetry"))
 
 
+# last-synced (request_tracing, sample_rate, telemetry_port): the hook
+# runs on EVERY set_flags (fault arming flips fault_injection
+# constantly in chaos tests) — skip the sync work when nothing
+# observability-shaped changed
+_last_sync = [None]
+_http_started = [False]
+
+
 def _on_flags_changed(flags):
     tracing._TRACER.set_flag(flags.get("telemetry", False))
+    state = (bool(flags.get("request_tracing", False)),
+             float(flags.get("trace_sample_rate", 1.0) or 0.0),
+             int(flags.get("telemetry_port", 0) or 0))
+    armed, rate, port = state
+    if state != _last_sync[0]:
+        _last_sync[0] = state
+        request_trace._TRACER.set_flag(armed, sample_rate=rate)
+        flight.RECORDER.set_armed(armed)
+    # The port sync is NOT deduped through _last_sync: a bind can fail
+    # (port taken) and re-issuing the same set_flags must RETRY it,
+    # not silently no-op. _sync_port_flag is idempotent when the
+    # server is already bound, and the http.server import stays off
+    # every process that never sets telemetry_port (only re-entered
+    # afterwards to stop the server).
+    if port or _http_started[0]:
+        from . import http as _http
+        _http._sync_port_flag(port)
+        _http_started[0] = bool(port)
 
 
 def _install_config_hook():

@@ -18,12 +18,22 @@ import math
 import threading
 
 __all__ = ["Registry", "Counter", "Gauge", "Histogram",
-           "REGISTRY", "default_registry", "DEFAULT_TIME_BUCKETS"]
+           "REGISTRY", "default_registry", "DEFAULT_TIME_BUCKETS",
+           "LATENCY_MS_BUCKETS"]
 
 # Latency buckets in seconds: 500us .. 60s, wide enough for both a CPU
 # test step and a tunneled-H2D TPU step (PROFILE.md measures both).
 DEFAULT_TIME_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                         0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+# Serving-stage latency buckets in MILLISECONDS, log-spaced from
+# sub-ms (a warmed decode step on a chip) to 60 s (a deadline-bounded
+# replay riding out a breaker cooldown): the per-stage request
+# histograms (observability/request_trace.py) use these instead of the
+# second-scale training buckets above.
+LATENCY_MS_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                      100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+                      10000.0, 30000.0, 60000.0)
 
 
 def _format_value(v):
@@ -142,13 +152,14 @@ class Family:
     """One named metric with typed children per label-values tuple."""
 
     def __init__(self, name, kind, help_text, labelnames, lock,
-                 buckets=None):
+                 buckets=None, registry=None):
         self.name = name
         self.kind = kind
         self.help = help_text
         self.labelnames = tuple(labelnames)
         self.buckets = tuple(sorted(buckets)) if buckets else None
         self._lock = lock
+        self._registry = registry
         self._children = {}
 
     def _make_child(self, labels):
@@ -166,6 +177,28 @@ class Family:
         with self._lock:
             child = self._children.get(key)
             if child is None:
+                reg = self._registry
+                cap = reg.label_cardinality_cap if reg is not None \
+                    else 0
+                # 0/None = unbounded, the repo-wide "off" convention
+                if cap and self.labelnames and \
+                        len(self._children) >= cap:
+                    # Cardinality backstop: per-request/per-session
+                    # labels (the "g<N>:*" / "e<N>:*" pattern) must
+                    # not grow a family without bound when a caller
+                    # forgets the retirement sweep. Dropping the
+                    # OLDEST child loses its history — counted, so an
+                    # operator sees the leak instead of the OOM.
+                    oldest = next(iter(self._children))
+                    del self._children[oldest]
+                    reg._label_evictions += 1
+                    if self.name != _LABEL_EVICTIONS_NAME:
+                        reg.counter(
+                            _LABEL_EVICTIONS_NAME,
+                            "Labeled children evicted by the registry "
+                            "cardinality cap (a leak signal: some "
+                            "per-request label set is not being "
+                            "retired)").inc()
                 child = self._make_child(dict(zip(self.labelnames, key)))
                 self._children[key] = child
             return child
@@ -203,6 +236,14 @@ class Family:
         return self._default().value
 
 
+_LABEL_EVICTIONS_NAME = "paddle_metrics_label_evictions_total"
+
+# families may legitimately key on per-replica/per-session labels, but
+# anything past this many live children of ONE family is a retirement
+# bug, not a deployment shape (override via REGISTRY attribute)
+DEFAULT_LABEL_CARDINALITY_CAP = 1024
+
+
 class Registry:
     """Named families; idempotent creation, mismatched re-creation raises."""
 
@@ -212,6 +253,9 @@ class Registry:
         # bumped by reset(); holders of cached children (utils.stat)
         # compare it to drop stale references
         self.generation = 0
+        # per-family bound on live labeled children (see Family.labels)
+        self.label_cardinality_cap = DEFAULT_LABEL_CARDINALITY_CAP
+        self._label_evictions = 0
 
     def _get_or_create(self, name, kind, help_text, labelnames, buckets):
         with self._lock:
@@ -222,11 +266,73 @@ class Registry:
                         "metric %r re-registered as %s%s (was %s%s)"
                         % (name, kind, tuple(labelnames), fam.kind,
                            fam.labelnames))
+                if kind == "histogram" and buckets is not None and \
+                        tuple(sorted(buckets)) != fam.buckets:
+                    self._override_buckets(fam, buckets)
                 return fam
+            if kind == "histogram" and buckets is None:
+                buckets = DEFAULT_TIME_BUCKETS
             fam = Family(name, kind, help_text, labelnames, self._lock,
-                         buckets=buckets)
+                         buckets=buckets, registry=self)
             self._families[name] = fam
             return fam
+
+    def _override_buckets(self, fam, buckets):
+        """Per-metric bucket override: re-registering a histogram with
+        different boundaries re-buckets it — legal only while no child
+        has observations (cumulative counts cannot be re-binned), so
+        call sites override at arm-time, before traffic."""
+        if any(c.count for c in fam._children.values()):
+            raise ValueError(
+                "histogram %r already holds observations — bucket "
+                "override %s must happen before traffic (was %s)"
+                % (fam.name, tuple(sorted(buckets)), fam.buckets))
+        fam.buckets = tuple(sorted(buckets))
+        for child in fam._children.values():
+            child.buckets = fam.buckets
+            child.bucket_counts = [0] * (len(fam.buckets) + 1)
+
+    def set_buckets(self, name, buckets):
+        """Explicit bucket override for a registered (still-unused)
+        histogram — the arm-time hook for serving-appropriate
+        boundaries on metrics declared with library defaults."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                raise KeyError("no histogram %r registered" % name)
+            if fam.kind != "histogram":
+                raise ValueError("metric %r is a %s, not a histogram"
+                                 % (name, fam.kind))
+            if tuple(sorted(buckets)) != fam.buckets:
+                self._override_buckets(fam, buckets)
+            return fam
+
+    def remove_labeled(self, label, value=None, prefix=None):
+        """Sweep EVERY family, dropping children whose ``label`` equals
+        ``value`` or starts with ``prefix`` — the PR-9 ``g<N>:*``
+        retirement pattern generalized: one call retires a whole
+        scheduler's/engine's namespace of per-replica children across
+        all the families that labelled on it. Returns the number of
+        children removed."""
+        if (value is None) == (prefix is None):
+            raise ValueError("pass exactly one of value= / prefix=")
+        removed = 0
+        with self._lock:
+            for fam in self._families.values():
+                if label not in fam.labelnames:
+                    continue
+                for key in [k for k, c in fam._children.items()
+                            if (c.labels_dict.get(label) == str(value)
+                                if value is not None else
+                                str(c.labels_dict.get(label, ""))
+                                .startswith(prefix))]:
+                    del fam._children[key]
+                    removed += 1
+        return removed
+
+    @property
+    def label_evictions(self):
+        return self._label_evictions
 
     def counter(self, name, help_text="", labelnames=()):
         return self._get_or_create(name, "counter", help_text, labelnames,
@@ -236,10 +342,13 @@ class Registry:
         return self._get_or_create(name, "gauge", help_text, labelnames,
                                    None)
 
-    def histogram(self, name, help_text="", labelnames=(),
-                  buckets=DEFAULT_TIME_BUCKETS):
-        return self._get_or_create(name, "histogram", help_text, labelnames,
-                                   buckets)
+    def histogram(self, name, help_text="", labelnames=(), buckets=None):
+        """``buckets=None`` = don't care: DEFAULT_TIME_BUCKETS at
+        creation, and a later fetch never re-buckets an existing
+        family. Explicit ``buckets`` on an existing family is a
+        per-metric override (legal while unused — see set_buckets)."""
+        return self._get_or_create(name, "histogram", help_text,
+                                   labelnames, buckets)
 
     def families(self):
         with self._lock:

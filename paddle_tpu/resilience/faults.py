@@ -195,8 +195,18 @@ def fire_point(site, index=None, default_exc=None):
     spec = should_fire(site, index)
     if spec is None:
         return None
+    # stamp the injected fault onto the request being served (chaos
+    # probes correlate "which request ate which fault" off the span
+    # tree); a thread-local read + None check, nothing when tracing
+    # is off
+    from ..observability import request_trace as _rtrace
+    ctx = _rtrace.current()
+    if ctx is not None:
+        _rtrace.event(ctx, "faultInjected", site=site, index=index,
+                      action=spec.action)
     _log.structured("fault_injected", site=site, index=index,
-                    action=spec.action)
+                    action=spec.action,
+                    trace_id=None if ctx is None else ctx.trace_id)
     if spec.action == "kill":
         os.kill(os.getpid(), signal.SIGKILL)
     if spec.action == "callback":

@@ -52,7 +52,9 @@ from concurrent.futures import Future
 import numpy as np
 
 from .. import config as _config
+from ..observability import flight as _flight
 from ..observability import metrics as _metrics
+from ..observability import request_trace as _rtrace
 from ..observability import tracing as _tracing
 from ..resilience import faults as _faults
 from . import resilience as _sres
@@ -74,13 +76,15 @@ class ServingOverloadError(RuntimeError):
 
 
 class _WorkItem:
-    __slots__ = ("feed", "future", "t_submit", "deadline")
+    __slots__ = ("feed", "future", "t_submit", "deadline", "ctx")
 
     def __init__(self, feed, deadline=None):
         self.feed = feed
         self.future = Future()
         self.t_submit = time.perf_counter()
         self.deadline = deadline  # absolute time.monotonic(), or None
+        # request-scoped TraceContext (None = tracing off / unsampled)
+        self.ctx = None
 
 
 _STOP = object()
@@ -93,7 +97,15 @@ _WAIT_ALPHA = 0.2
 def _resolve(future, result=None, exception=None):
     """Set a Future's outcome without letting a client-side cancel()
     (racing the cancelled() check) raise InvalidStateError and kill the
-    dispatcher thread."""
+    dispatcher thread.
+
+    Every exceptional resolution across the serving stack funnels
+    through here, which makes it the one flight-recorder hook for
+    "client-visible error": armed, a failure storm auto-dumps one
+    debounced post-mortem bundle; disarmed, it is one attribute
+    check."""
+    if exception is not None:
+        _flight.RECORDER.client_error(exception)
     try:
         if not future.cancelled():
             if exception is not None:
@@ -214,9 +226,15 @@ class MicroBatcher:
             self._validate(name, a)
             arrays[name] = a
         item = _WorkItem(arrays, deadline=deadline)
+        # trace minted at the front door, carried on the queue item;
+        # one attribute read when request_tracing is off
+        item.ctx = _rtrace.mint("serving.submit", seq=seq)
         try:
             self._q.put(item, block=True, timeout=timeout)
         except queue.Full:
+            # never entered the system: a rejection storm must not
+            # churn real in-flight traces out of the bounded store
+            _rtrace.discard(item.ctx)
             raise ServingOverloadError(
                 "serving queue full (%d pending)" % self._q.qsize()) \
                 from None
@@ -227,6 +245,7 @@ class MicroBatcher:
             # makes a later pop by drain a no-op) and refuse the
             # submit. Only ours: a concurrent drain() still owns and
             # serves every other accepted item.
+            _rtrace.discard(item.ctx)
             _resolve(item.future,
                      exception=RuntimeError("batcher closed"))
             raise RuntimeError("batcher is closed")
@@ -274,12 +293,18 @@ class MicroBatcher:
         for it in batch:
             if it.deadline is not None and now >= it.deadline:
                 _sres.DEADLINE_EXCEEDED.inc()
+                if it.ctx is not None:
+                    _rtrace.event(it.ctx, "deadlineExpired",
+                                  where="in queue")
                 _resolve(it.future, exception=ServingDeadlineError(
                     "deadline expired after %.1f ms in queue"
                     % ((time.perf_counter() - it.t_submit) * 1e3)))
             else:
                 wait = time.perf_counter() - it.t_submit
                 self._wait_ewma += _WAIT_ALPHA * (wait - self._wait_ewma)
+                _rtrace.QUEUE_WAIT_MS.observe(wait * 1e3)
+                if it.ctx is not None:
+                    _rtrace.event(it.ctx, "queueWait", dur_ms=wait * 1e3)
                 live.append(it)
         if not live:
             return
@@ -296,13 +321,35 @@ class MicroBatcher:
             self._flush_group(group)
 
     def _flush_group(self, batch):
+        # the shape-group flush is a lifecycle edge on EVERY sampled
+        # member's trace; the engine dispatch (replica choice,
+        # failover hops, device call) is activated under the FIRST
+        # sampled member's context — co-batched requests share one
+        # physical execution, so one trace carries its detail
+        lead_ctx = None
+        for it in batch:
+            if it.ctx is not None:
+                if lead_ctx is None:
+                    lead_ctx = it.ctx
+                _rtrace.event(it.ctx, "shapeGroupFlush",
+                              size=len(batch),
+                              lead=lead_ctx.trace_id)
         try:
-            with _tracing.span("servingBatch", size=len(batch)):
+            # nothing sampled -> activate the NO_TRACE sentinel, not
+            # None: the engine below must see "sampling already
+            # decided against this batch" and not mint its own orphan
+            # 'serving.run' trace for it
+            with _tracing.span("servingBatch", size=len(batch)), \
+                    _rtrace.activate(lead_ctx if lead_ctx is not None
+                                     else _rtrace.NO_TRACE):
                 feed = {name: np.stack([it.feed[name] for it in batch])
                         for name in self.engine.feed_names}
                 outs = self.engine.run(feed)
         except Exception as exc:  # engine failure, every replica down...
             for it in batch:
+                if it.ctx is not None:
+                    _rtrace.event(it.ctx, "resolveError",
+                                  error=repr(exc)[:200])
                 _resolve(it.future, exception=exc)
             return
         now = time.perf_counter()
@@ -310,7 +357,11 @@ class MicroBatcher:
             res = [o[i] if getattr(o, "ndim", 0) > 0 and
                    o.shape[0] == len(batch) else o for o in outs]
             _resolve(it.future, result=res)
-            _REQUEST_SECONDS.observe(now - it.t_submit)
+            e2e = now - it.t_submit
+            _REQUEST_SECONDS.observe(e2e)
+            _rtrace.E2E_MS.observe(e2e * 1e3)
+            if it.ctx is not None:
+                _rtrace.event(it.ctx, "resolve", dur_ms=e2e * 1e3)
 
     # -- lifecycle -------------------------------------------------------
     def _stop_dispatcher(self, timeout):

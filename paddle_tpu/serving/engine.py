@@ -57,6 +57,7 @@ import itertools
 import os
 import threading
 import time
+import weakref
 
 import numpy as np
 
@@ -68,6 +69,7 @@ from ..core import compile_cache as _cc
 from ..core.executor import Executor
 from ..core.scope import Scope
 from ..observability import metrics as _metrics
+from ..observability import request_trace as _rtrace
 from ..observability import tracing as _tracing
 from ..resilience import faults as _faults
 from ..utils import log as _log
@@ -103,6 +105,22 @@ _OVERFLOWS = _metrics.REGISTRY.counter(
 # distinguishes per-replica health gauges when several breaker-armed
 # engines share the process-global metric registry
 _ENGINE_SEQ = itertools.count()
+
+
+def _engine_health(ref):
+    """The /healthz component callable for one engine: healthy while
+    any replica's breaker is in rotation; None once the engine is
+    garbage-collected (the health registry drops it lazily)."""
+    def snapshot():
+        eng = ref()
+        if eng is None:
+            return None
+        states = eng.replica_health()
+        return {"healthy": not eng._closed and
+                any(s != "open" for s in states),
+                "closed": eng._closed,
+                "replicas": states}
+    return snapshot
 
 
 class _Replica:
@@ -239,6 +257,14 @@ class ServingEngine:
             self._aot_index = _deploy.load_compiled_index(artifact_dir) \
                 if use_exported else None
 
+            # live introspection: /healthz aggregates every live
+            # engine's replica-breaker view (weakref — a GC'd engine
+            # drops out lazily; close() unregisters eagerly)
+            from ..observability import health as _health
+            self._health_name = "engine%d" % self._engine_id
+            _health.register_health(self._health_name,
+                                  _engine_health(weakref.ref(self)))
+
             if warmup:
                 self.warmup()
         except Exception:
@@ -250,6 +276,13 @@ class ServingEngine:
             if unpacked is not None:
                 import shutil
                 shutil.rmtree(unpacked, ignore_errors=True)
+            # nor a phantom /healthz component: the half-built engine
+            # stays referenced by the raised exception's traceback, so
+            # the weakref would keep reporting it "healthy" while it
+            # serves nothing
+            if getattr(self, "_health_name", None):
+                from ..observability import health as _health
+                _health.unregister_health(self._health_name)
             raise
         _deploy.COLD_START_SECONDS.set(time.perf_counter() - t_cold)
 
@@ -278,6 +311,15 @@ class ServingEngine:
                 rep.scope.set_var(name, val)
             _log.structured("swap_flip_recovered", replica=rep.index)
 
+    def _activated_execute(self, rep, feed, bucket, ctx):
+        # the trace context is activated HERE — around the _execute
+        # call, not at the run() call site — because the timed path
+        # runs this on run_bounded's worker thread, where the caller's
+        # thread-local would be invisible: the device-call span must
+        # follow the execution wherever it runs
+        with _rtrace.activate(ctx):
+            return self._execute(rep, feed, bucket)
+
     def _execute(self, rep, feed, bucket):
         _faults.fire_point("serving_replica_fail", index=rep.index)
         sig = tuple(sorted((n, a.shape) for n, a in feed.items()))
@@ -299,7 +341,7 @@ class ServingEngine:
                 _BUCKET_COMPILES.labels(bucket=bucket).inc()
         return outs
 
-    def _execute_timed(self, rep, feed, bucket, timeout):
+    def _execute_timed(self, rep, feed, bucket, timeout, ctx=None):
         """Run ``_execute`` bounded by ``timeout`` seconds via the
         shared worker-thread pattern (``resilience.run_bounded``): a
         hung device execution is left to finish on its worker thread
@@ -320,8 +362,8 @@ class ServingEngine:
                         "execution" % rep.index)
         try:
             return _sres.run_bounded(
-                lambda: self._execute(rep, feed, bucket), timeout,
-                name="serving-exec-%d" % rep.index)
+                lambda: self._activated_execute(rep, feed, bucket, ctx),
+                timeout, name="serving-exec-%d" % rep.index)
         except ServingTimeoutError as err:
             pending = getattr(err, "pending", None)
             if pending is not None:
@@ -333,12 +375,16 @@ class ServingEngine:
                         rep.stuck = pending
             raise
 
-    def _run_once(self, rep, arrays, bucket, timeout):
+    def _run_once(self, rep, arrays, bucket, timeout, ctx=None):
         t0 = time.perf_counter()
+        if ctx is not None:
+            _rtrace.event(ctx, "dispatch", replica=rep.index,
+                          bucket=bucket)
         if timeout is not None:
-            outs = self._execute_timed(rep, arrays, bucket, timeout)
+            outs = self._execute_timed(rep, arrays, bucket, timeout,
+                                       ctx=ctx)
         else:
-            outs = self._execute(rep, arrays, bucket)
+            outs = self._activated_execute(rep, arrays, bucket, ctx)
         _BATCH_SECONDS.labels(bucket=bucket).observe(
             time.perf_counter() - t0)
         return outs
@@ -449,7 +495,28 @@ class ServingEngine:
             # before touching round-robin/breaker state
             _sres.DEADLINE_EXCEEDED.inc()
             raise ServingDeadlineError("deadline expired before dispatch")
+        # a batcher flush arrives with its lead request's context
+        # already active (or the NO_TRACE sentinel, when the front
+        # door above us sampled nothing — minting here would fill the
+        # bounded store with orphan traces the operator chose not to
+        # record); only a DIRECT engine call mints its own, and only
+        # AFTER feed validation: a malformed-feed storm must not
+        # churn real traces out of the bounded store with root-only
+        # orphans. One attribute read when request_tracing is off.
+        ctx = _rtrace.current()
+        if ctx is not None and ctx.trace_id is None:
+            ctx = None
+            mint_own = False
+        else:
+            mint_own = ctx is None
         arrays, n, bucket = self._prepare(feed)
+        if mint_own:
+            ctx = _rtrace.mint("serving.run", bucket=bucket, n=int(n))
+        # terminal edges (resolve/resolveError/deadlineExpired) are
+        # recorded only on traces minted HERE: for an inherited
+        # context the batcher owns the Future and records the one
+        # ending — the engine contributes lifecycle edges only
+        # (dispatch, failover, deviceCall).
         v0 = self._weights_version  # detect a mid-request weight flip
 
         if self._breakers is None and timeout is None and \
@@ -461,18 +528,25 @@ class ServingEngine:
             # weights gets the transparent retry, not the bad push.
             rep = self.replicas[next(self._rr) % len(self.replicas)]
             try:
-                outs = self._run_once(rep, arrays, bucket, None)
-            except Exception:
+                outs = self._run_once(rep, arrays, bucket, None,
+                                      ctx=ctx)
+            except Exception as exc:
                 if self._swap_watch is None and \
                         not self._rollback_pending and \
                         not self._swap_admin.locked() and \
                         self._weights_version == v0:
+                    if mint_own and ctx is not None:
+                        _rtrace.event(ctx, "resolveError",
+                                      error=repr(exc)[:200])
                     raise  # a plain failure, no swap anywhere near it
                 # a swap/rollback raced this dispatch (the guard saw
                 # pre-swap state, the execution saw the new weights):
                 # fall through to the slow path, which owns the
                 # watch/retry bookkeeping
             else:
+                if mint_own and ctx is not None:
+                    _rtrace.event(ctx, "resolve", bucket=bucket,
+                                  n=int(n))
                 return self._finish(outs, n, bucket)
 
         last_exc = None
@@ -480,6 +554,9 @@ class ServingEngine:
         for attempt in (0, 1):
             candidates = self._candidates()
             if not candidates:
+                if mint_own and ctx is not None:
+                    _rtrace.event(ctx, "resolveError",
+                                  error="no healthy replica")
                 raise ServingUnavailableError(
                     "no healthy replica (all %d breakers open)"
                     % len(self.replicas))
@@ -487,12 +564,16 @@ class ServingEngine:
             for pos, idx in enumerate(candidates):
                 if deadline is not None and time.monotonic() >= deadline:
                     _sres.DEADLINE_EXCEEDED.inc()
+                    if mint_own and ctx is not None:
+                        _rtrace.event(ctx, "deadlineExpired",
+                                      where="before dispatch")
                     raise ServingDeadlineError(
                         "deadline expired before dispatch")
                 rep = self.replicas[idx]
                 breaker = self._breakers[idx] if self._breakers else None
                 try:
-                    outs = self._run_once(rep, arrays, bucket, timeout)
+                    outs = self._run_once(rep, arrays, bucket, timeout,
+                                          ctx=ctx)
                 except Exception as exc:
                     last_exc = exc
                     final = breaker is None or \
@@ -555,16 +636,31 @@ class ServingEngine:
                         if rolled and attempt == 0:
                             retry = True
                             break
+                        if mint_own and ctx is not None:
+                            _rtrace.event(ctx, "resolveError",
+                                          error=repr(exc)[:200])
                         raise
                     _sres.FAILOVER.inc()
+                    if ctx is not None:
+                        _rtrace.event(ctx, "failover",
+                                      from_replica=idx,
+                                      hang=isinstance(
+                                          exc, ServingTimeoutError),
+                                      error=repr(exc)[:200])
                     continue
                 if breaker is not None:
                     breaker.record_success()
                 if self._swap_watch is not None:
                     self._swap_note(True)
+                if mint_own and ctx is not None:
+                    _rtrace.event(ctx, "resolve", bucket=bucket,
+                                  n=int(n))
                 return self._finish(outs, n, bucket)
             if not retry:
                 break
+        if mint_own and ctx is not None:
+            _rtrace.event(ctx, "resolveError",
+                          error=repr(last_exc)[:200])
         raise last_exc
 
     # -- resilience ------------------------------------------------------
@@ -598,6 +694,8 @@ class ServingEngine:
         """Refuse new work and stop the probe thread. In-flight runs
         finish; the process is left cleanly restartable (a new engine
         over the same export rebuilds everything)."""
+        from ..observability import health as _health
+        _health.unregister_health(getattr(self, "_health_name", ""))
         with self._probe_lock:  # vs a racing _ensure_probe start
             self._closed = True
             probe, self._probe = self._probe, None
@@ -609,12 +707,15 @@ class ServingEngine:
             shutil.rmtree(unpacked, ignore_errors=True)
         if self._breakers is not None:
             for breaker in self._breakers:
-                # drop this engine's health gauge children so redeploy
-                # cycles don't accumulate stale per-engine labels;
                 # retire first so a straggling probe/run can't
-                # resurrect the child
+                # resurrect a gauge child the sweep below removes
                 breaker.retired = True
-                _sres.REPLICA_HEALTHY.remove(replica=breaker.label)
+            # drop every family's children labelled on this engine's
+            # "e<N>:*" namespace in one registry sweep, so redeploy
+            # cycles don't accumulate stale per-engine labels (the
+            # scheduler tier's close() discipline)
+            _metrics.REGISTRY.remove_labeled(
+                "replica", prefix="e%d:" % self._engine_id)
 
     def __enter__(self):
         return self

@@ -109,7 +109,9 @@ import numpy as np
 from .. import config as _config
 from ..core.executor import Executor
 from ..core.scope import global_scope
+from ..observability import flight as _flight
 from ..observability import metrics as _metrics
+from ..observability import request_trace as _rtrace
 from ..observability import tracing as _tracing
 from ..resilience import faults as _faults
 from ..utils import log as _log
@@ -181,6 +183,40 @@ _REBUILD_AFTER_TRIALS = 2
 
 # distinguishes per-session breaker gauge labels across schedulers
 _SCHED_SEQ = itertools.count()
+
+def _scheduler_health(ref):
+    """The /healthz component callable for one scheduler: healthy
+    while any session can take traffic (closed/half-open breaker) or
+    a rebuild is on its way back; None once the scheduler is
+    garbage-collected."""
+    def snapshot():
+        sched = ref()
+        if sched is None:
+            return None
+        states = sched.session_health()
+        # _rebuilding belongs to the dispatcher thread and has no
+        # lock; this runs on the HTTP request thread, so a concurrent
+        # mutation can kill the iteration — retry rather than letting
+        # health_snapshot's catch report a healthy scheduler as
+        # degraded during exactly the rebuild windows /healthz exists
+        # to observe
+        for _ in range(4):
+            try:
+                rebuilding = sorted(sched._rebuilding)
+                break
+            except RuntimeError:
+                continue
+        else:
+            rebuilding = []
+        return {"healthy": not sched._closed and
+                (any(s != "open" for s in states)
+                 or bool(rebuilding)),
+                "closed": sched._closed,
+                "sessions": states,
+                "rebuilding": rebuilding,
+                "active": len(sched._active)}
+    return snapshot
+
 
 # scope -> set of cache-variable names already driven by a live
 # session. Two sessions sharing cache names on one scope would
@@ -470,6 +506,10 @@ class GenerationSession:
         self.pool.decref(old)
         table[idx] = new
         BLOCK_COWS.inc()
+        # lands on the admitting request's trace (admit-path COW runs
+        # under its activated context); step_prepare COWs have no
+        # single owner and reach only the flight ring
+        _rtrace.global_event("blockCOW", src=int(old), dst=int(new))
 
     def close(self):
         """Release this session's cache-variable claim (and drop the
@@ -796,7 +836,7 @@ class _GenRequest:
     __slots__ = ("prompt", "max_new", "explicit_budget", "eos_id",
                  "future", "deadline", "t_submit", "tokens", "slot",
                  "session_index", "t_last", "t_queued", "replays",
-                 "charged", "failed_on", "last_exc")
+                 "charged", "failed_on", "last_exc", "ctx")
 
     def __init__(self, prompt, max_new, explicit_budget, eos_id,
                  deadline):
@@ -838,6 +878,11 @@ class _GenRequest:
         # prompt bucket, no session ever heals), THIS surfaces — not
         # a generic unavailable error that masks what happened
         self.last_exc = None
+        # request-scoped TraceContext (None = tracing off/unsampled).
+        # It lives on the SAME object as the replay journal, so a
+        # failover hop keeps its trace id across sessions for free —
+        # the one-trace-per-request contract.
+        self.ctx = None
 
     def history(self):
         """The replay journal: prompt plus every token generated so
@@ -962,6 +1007,13 @@ class GenerationScheduler:
         self._swap_lock = threading.Lock()
         self._pending_swap = None  # (params, Future)
         self._weights_version = 0
+        # live introspection: /healthz aggregates every live
+        # scheduler's session view (weakref — GC drops it lazily,
+        # the dispatcher-exit epilogue unregisters eagerly)
+        from ..observability import health as _health
+        self._health_name = "generation%d" % self._sched_id
+        _health.register_health(self._health_name,
+                              _scheduler_health(weakref.ref(self)))
         if autostart:
             self.start()
 
@@ -1044,10 +1096,19 @@ class GenerationScheduler:
                     % (projected * 1e3, budget * 1e3))
             deadline = time.monotonic() + budget
         item = _GenRequest(prompt, max_new, explicit, eos_id, deadline)
+        # minted at the front door (one attribute read when off),
+        # carried on the item/journal through every queue, session,
+        # and replay hop
+        item.ctx = _rtrace.mint("generation.submit",
+                                prompt_len=int(prompt.size),
+                                max_new=int(max_new))
         try:
             self._q.put(item, block=True, timeout=timeout)
         except queue.Full:
             _sres.SHED.inc()
+            # never entered the system: a rejection storm must not
+            # churn real in-flight traces out of the bounded store
+            _rtrace.discard(item.ctx)
             raise ServingOverloadError(
                 "generation queue full (%d pending)"
                 % self._q.qsize()) from None
@@ -1060,6 +1121,7 @@ class GenerationScheduler:
             # raced a close()/drain() past its leftover sweep (the
             # batcher's shutdown race, same resolution: fail OUR
             # future idempotently and refuse the submit)
+            _rtrace.discard(item.ctx)
             _resolve(item.future,
                      exception=RuntimeError("scheduler closed"))
             raise RuntimeError("scheduler is closed")
@@ -1213,8 +1275,22 @@ class GenerationScheduler:
                 return True
         return False
 
+    def _resolve_err(self, item, exc):
+        """Exceptional resolution WITH its trace ending: every failed
+        request's span tree ends in a ``resolveError`` edge (deadline
+        endings have their own ``deadlineExpired``), so the trace an
+        operator pulls for a failure never just stops mid-life."""
+        if item.ctx is not None:
+            _rtrace.event(item.ctx, "resolveError",
+                          error=repr(exc)[:200],
+                          error_type=type(exc).__name__)
+        _resolve(item.future, exception=exc)
+
     def _expire(self, item, where):
         _sres.DEADLINE_EXCEEDED.inc()
+        if item.ctx is not None:
+            _rtrace.event(item.ctx, "deadlineExpired", where=where,
+                          replays=item.replays)
         _resolve(item.future, exception=ServingDeadlineError(
             "deadline expired after %.1f ms %s"
             % ((time.perf_counter() - item.t_submit) * 1e3, where)))
@@ -1281,11 +1357,11 @@ class GenerationScheduler:
             # generic unavailable error would mask it — e.g. when the
             # journal outgrew every prompt bucket, the caller should
             # see why the generation actually died).
-            _resolve(item.future, exception=item.last_exc
-                     if item.last_exc is not None
-                     else ServingUnavailableError(
-                         "no healthy generation session for this "
-                         "prompt"))
+            self._resolve_err(item, item.last_exc
+                              if item.last_exc is not None
+                              else ServingUnavailableError(
+                                  "no healthy generation session for "
+                                  "this prompt"))
             return True
         self._admit_item(item, si)
         return True
@@ -1293,16 +1369,25 @@ class GenerationScheduler:
     def _admit_item(self, item, si):
         wait = time.perf_counter() - item.t_queued
         self._wait_ewma += _WAIT_ALPHA * (wait - self._wait_ewma)
+        _rtrace.QUEUE_WAIT_MS.observe(wait * 1e3)
         sess = self.sessions[si]
         replay = bool(item.tokens)
+        if item.ctx is not None:
+            _rtrace.event(item.ctx, "queueWait", dur_ms=wait * 1e3,
+                          replay=replay)
+        t_admit0 = time.perf_counter()
         try:
-            _faults.fire_point("generation_admit_fail", index=si)
-            slot, first = sess.admit(item.history())
+            # the activated context follows the admission into the
+            # fault hook and the prefill's executor.run (deviceCall
+            # spans land on this request's trace)
+            with _rtrace.activate(item.ctx):
+                _faults.fire_point("generation_admit_fail", index=si)
+                slot, first = sess.admit(item.history())
         except ValueError as exc:
             # a client-shaped prompt (bucket/length) is the request's
             # fault, not the session's — it must not charge the
             # breaker and quarantine a healthy session
-            _resolve(item.future, exception=exc)
+            self._resolve_err(item, exc)
             return
         except Exception as exc:
             self._on_admit_failure(item, si, exc)
@@ -1313,12 +1398,28 @@ class GenerationScheduler:
         if item.eos_id is None:
             item.eos_id = sess.spec.eos_id
         now_pc = time.perf_counter()
+        _rtrace.PREFILL_MS.observe((now_pc - t_admit0) * 1e3)
+        if item.ctx is not None:
+            # hist = prefix-cache hit length: tokens served from
+            # shared blocks instead of re-prefilled (0 on the dense
+            # layout and on a prefix miss)
+            hist = sess.prefill_log[-1][1] \
+                if getattr(sess, "paged", False) and sess.prefill_log \
+                else 0
+            _rtrace.event(item.ctx,
+                          "replayAdmit" if replay else "prefill",
+                          dur_ms=(now_pc - t_admit0) * 1e3,
+                          session=si, slot=slot,
+                          journal_len=int(item.prompt.size)
+                          + len(item.tokens), hist=int(hist))
         if replay:
             # the same logical request, resumed — requests_total must
             # not double-count it; the re-prefilled history is what
             # the failover actually cost
             _REPLAYED_TOKENS.inc(len(item.tokens))
             _RECOVERY_SECONDS.observe(now_pc - item.t_queued)
+            _rtrace.REPLAY_RECOVERY_MS.observe(
+                (now_pc - item.t_queued) * 1e3)
         else:
             _REQUESTS.inc()
             _TTFT_SECONDS.observe(now_pc - item.t_submit)
@@ -1346,6 +1447,10 @@ class GenerationScheduler:
             if was_trial:
                 self._trial_failures[si] += 1
         item.failed_on.add(si)
+        if item.ctx is not None:
+            _rtrace.event(item.ctx, "admitFailure", session=si,
+                          trial=was_trial if breaker is not None
+                          else False, error=repr(exc)[:200])
         _log.structured("generation_admit_failed", session=si,
                         error=repr(exc), replay=bool(item.tokens))
         self._maybe_rebuild(si)
@@ -1372,6 +1477,16 @@ class GenerationScheduler:
             item.t_queued = time.perf_counter()
             item.last_exc = exc
             _FAILOVERS.inc()
+            if item.ctx is not None:
+                # the failover hop, from the journal's side: the next
+                # replayAdmit event names the NEW session — together
+                # they are the old-session -> new-session edge
+                _rtrace.event(item.ctx, "failoverRequeue",
+                              from_session=item.session_index,
+                              replays=item.replays,
+                              journal_len=int(item.prompt.size)
+                              + len(item.tokens),
+                              error=repr(exc)[:200])
             self._pending.appendleft(item)
         if any(item.deadline is not None for item in requeued):
             # the expiry sweep must keep covering parked replays: a
@@ -1379,7 +1494,7 @@ class GenerationScheduler:
             # ever re-prefilling
             self._has_deadlines = True
         for item in spent:
-            _resolve(item.future, exception=exc)
+            self._resolve_err(item, exc)
         return requeued
 
     def _finish_if_done(self, item):
@@ -1404,12 +1519,21 @@ class GenerationScheduler:
         _RETIRED.labels(reason=reason).inc()
         if reason == "deadline":
             _sres.DEADLINE_EXCEEDED.inc()
+            if item.ctx is not None:
+                _rtrace.event(item.ctx, "deadlineExpired",
+                              where="mid-generation",
+                              tokens=len(item.tokens))
             _resolve(item.future, exception=ServingDeadlineError(
                 "deadline expired mid-generation after %d tokens"
                 % len(item.tokens)))
         else:
-            _REQUEST_SECONDS.observe(time.perf_counter()
-                                     - item.t_submit)
+            e2e = time.perf_counter() - item.t_submit
+            _REQUEST_SECONDS.observe(e2e)
+            _rtrace.E2E_MS.observe(e2e * 1e3)
+            if item.ctx is not None:
+                _rtrace.event(item.ctx, "resolve", reason=reason,
+                              tokens=len(item.tokens),
+                              dur_ms=e2e * 1e3)
             _resolve(item.future,
                      result=np.asarray(item.tokens, np.int64))
         self._update_occupancy()
@@ -1420,14 +1544,16 @@ class GenerationScheduler:
         the inline path and the bounded worker, so injected faults
         (including a wedge callback) land inside whatever bounds the
         step. ``prepared`` carries a host-side step_prepare() handle
-        when the caller already ran phase 1 (the bounded path)."""
+        when the caller already ran phase 1 — _step_all does on both
+        paths, keeping pool mutation on the dispatcher thread and
+        outside any request's activated trace context."""
         _faults.fire_point("generation_session_wedge", index=si)
         _faults.fire_point("generation_step_fail", index=si)
         if prepared is not None:
             return sess.step_run(prepared)
         return sess.step()
 
-    def _step_timed(self, si, sess):
+    def _step_timed(self, si, sess, prepared):
         """Step bounded by ``self.step_timeout`` on a worker thread
         (resilience.run_bounded). A hang raises ServingTimeoutError
         and marks the session wedged — its stuck worker is leaked and
@@ -1435,15 +1561,13 @@ class GenerationScheduler:
         placement and stepping until the thread finishes, so retries
         can't stack blocked threads behind a dead device call.
 
-        The session's step_prepare() phase — which on the paged
-        layout mutates the block-pool books — runs HERE on the
-        dispatcher thread, before the worker: a worker leaked past
-        its timeout only ever executes the device call plus per-slot
-        scalar advances, never allocator mutation, so it cannot race
-        the dispatcher's retire()/close() on the pool accounting."""
-        prepared = sess.step_prepare()
-        if prepared is None:
-            return {}
+        ``prepared`` is the session's step_prepare() handle, produced
+        by _step_all on the dispatcher thread — which on the paged
+        layout is where ALL block-pool mutation happens: a worker
+        leaked past its timeout only ever executes the device call
+        plus per-slot scalar advances, never allocator mutation, so
+        it cannot race the dispatcher's retire()/close() on the pool
+        accounting."""
         try:
             return _sres.run_bounded(
                 lambda: self._step_session(si, sess, prepared),
@@ -1489,13 +1613,17 @@ class GenerationScheduler:
             sess.retire(slot)
             self._active.pop((si, slot), None)
             it.failed_on.add(si)
+            if it.ctx is not None:
+                _rtrace.event(it.ctx, "sessionFailure", session=si,
+                              slot=slot, hang=hang,
+                              error=repr(exc)[:200])
         items = [it for _, it in mine]
         requeued = set()
         if self.replay_attempts:
             requeued = set(map(id, self._requeue_for_replay(items, exc)))
         else:
             for it in items:
-                _resolve(it.future, exception=exc)
+                self._resolve_err(it, exc)
         for it in items:
             _RETIRED.labels(
                 reason="failover" if id(it) in requeued
@@ -1514,11 +1642,29 @@ class GenerationScheduler:
             if not mine:
                 continue
             breaker = self._breakers[si] if self._breakers else None
+            # one decode program serves every co-resident request:
+            # the step's deviceCall span is carried by the FIRST
+            # sampled request's context (the inline path; a
+            # worker-bounded step loses it by design), each sampled
+            # request then gets its own slot-annotated decodeStep
+            # event below
+            step_ctx = next((it.ctx for _, it in mine
+                             if it.ctx is not None), None)
+            t_step0 = time.perf_counter()
             try:
-                if self.step_timeout is not None:
-                    toks = self._step_timed(si, sess)
+                # step_prepare runs OUTSIDE the activated context on
+                # both paths: its paged pool mutations (grow, COW,
+                # eviction pressure) are batch-level — slot B's COW
+                # must not land in request A's span tree, so those
+                # global events reach only the flight ring
+                prepared = sess.step_prepare()
+                if prepared is None:
+                    toks = {}
+                elif self.step_timeout is not None:
+                    toks = self._step_timed(si, sess, prepared)
                 else:
-                    toks = self._step_session(si, sess)
+                    with _rtrace.activate(step_ctx):
+                        toks = self._step_session(si, sess, prepared)
             except Exception as exc:
                 hang = isinstance(exc, _sres.ServingTimeoutError)
                 self._on_session_failure(si, sess, mine, exc,
@@ -1529,6 +1675,8 @@ class GenerationScheduler:
                 self._trial_failures[si] = 0
             _STEPS.inc()
             now_pc = time.perf_counter()
+            step_ms = (now_pc - t_step0) * 1e3
+            _rtrace.DECODE_STEP_MS.observe(step_ms)
             advanced = 0
             for slot, it in mine:
                 if slot not in toks:
@@ -1552,6 +1700,10 @@ class GenerationScheduler:
                         from .paged_cache import PoolExhausted
                         it.failed_on.add(si)
                         _RETIRED.labels(reason="preempted").inc()
+                        if it.ctx is not None:
+                            _rtrace.event(it.ctx, "preempted",
+                                          session=si, slot=slot,
+                                          tokens=len(it.tokens))
                         self._requeue_for_replay(
                             [it], PoolExhausted(
                                 "session %d pool exhausted after %d "
@@ -1564,6 +1716,11 @@ class GenerationScheduler:
                     # table
                     _RETIRED.labels(reason="capacity").inc()
                     _REQUEST_SECONDS.observe(now_pc - it.t_submit)
+                    _rtrace.E2E_MS.observe((now_pc - it.t_submit) * 1e3)
+                    if it.ctx is not None:
+                        _rtrace.event(it.ctx, "resolve",
+                                      reason="capacity",
+                                      tokens=len(it.tokens))
                     _resolve(it.future,
                              result=np.asarray(it.tokens, np.int64))
                     continue
@@ -1571,6 +1728,11 @@ class GenerationScheduler:
                 it.tokens.append(toks[slot])
                 _INTER_TOKEN_SECONDS.observe(now_pc - it.t_last)
                 it.t_last = now_pc
+                if it.ctx is not None:
+                    _rtrace.event(it.ctx, "decodeStep",
+                                  dur_ms=step_ms, session=si,
+                                  slot=slot, active=len(mine),
+                                  token_index=len(it.tokens))
                 self._finish_if_done(it)
             _TOKENS.inc(advanced)
 
@@ -1594,6 +1756,14 @@ class GenerationScheduler:
             return  # live requests still decoding there; next event
         self._rebuilding.add(si)
         self._rebuilds[si] += 1
+        # a rebuild is incident-grade (quarantine became repair):
+        # annotate the active request's trace and snapshot the flight
+        # ring while the lead-up events are still in it
+        _rtrace.global_event("sessionRebuildStart", session=si,
+                            forced=bool(force),
+                            rebuilds=self._rebuilds[si])
+        _flight.RECORDER.trigger_async("session_rebuild", session=si,
+                                       forced=bool(force))
         threading.Thread(
             target=self._rebuild_worker, args=(si, sess),
             name="generation-rebuild-%d" % si, daemon=True).start()
@@ -1750,6 +1920,8 @@ class GenerationScheduler:
                 # fresh warmed session: straight back into rotation
                 self._breakers[si].record_success()
             _REBUILDS.inc()
+            _rtrace.global_event("sessionRebuilt", session=si,
+                                 seconds=round(secs, 3))
             _log.structured("generation_session_rebuilt", session=si,
                             seconds=round(secs, 3),
                             rebuilds=self._rebuilds[si])
@@ -1879,12 +2051,11 @@ class GenerationScheduler:
                 # unplaceable with nothing in flight (external slot
                 # holders): resolve rather than spinning forever
                 parked = self._pending.popleft()
-                _resolve(parked.future,
-                         exception=parked.last_exc
-                         if parked.last_exc is not None
-                         else ServingUnavailableError(
-                             "scheduler stopped before the request "
-                             "could be placed"))
+                self._resolve_err(parked, parked.last_exc
+                                  if parked.last_exc is not None
+                                  else ServingUnavailableError(
+                                      "scheduler stopped before the "
+                                      "request could be placed"))
 
     def _dispatcher_exit(self):
         """Dispatcher epilogue: nothing absorbs rebuilds past this
@@ -1897,6 +2068,8 @@ class GenerationScheduler:
         self._terminal = True
         self._drain_rebuilt()
         self._retire_breaker_gauges()
+        from ..observability import health as _health
+        _health.unregister_health(getattr(self, "_health_name", ""))
 
     def _loop(self):
         try:
@@ -2011,12 +2184,11 @@ class GenerationScheduler:
                 # unplaceable with nothing in flight (external slot
                 # holders): resolve rather than spinning forever
                 parked = self._pending.popleft()
-                _resolve(parked.future,
-                         exception=parked.last_exc
-                         if parked.last_exc is not None
-                         else ServingUnavailableError(
-                             "drain: no session could take the "
-                             "request"))
+                self._resolve_err(parked, parked.last_exc
+                                  if parked.last_exc is not None
+                                  else ServingUnavailableError(
+                                      "drain: no session could take "
+                                      "the request"))
         self._dispatcher_exit()  # retires the health gauges too
 
     def _drain_rebuilt(self):
@@ -2045,7 +2217,11 @@ class GenerationScheduler:
             return
         for breaker in self._breakers:
             breaker.retired = True
-            _sres.REPLICA_HEALTHY.remove(replica=breaker.label)
+        # the registry-level sweep retires every family labelled on
+        # this scheduler's "g<N>:*" namespace in one pass (the PR-9
+        # per-child removal, generalized)
+        _metrics.REGISTRY.remove_labeled(
+            "replica", prefix="g%d:" % self._sched_id)
 
     def close(self, timeout=5.0):
         """Fast exit: a live dispatcher serves out everything it owns
@@ -2054,8 +2230,7 @@ class GenerationScheduler:
         accepted Future is ever left hanging; with no dispatcher
         running, queued requests are failed instead."""
         for item in self._stop_dispatcher(timeout):
-            _resolve(item.future,
-                     exception=RuntimeError("scheduler closed"))
+            self._resolve_err(item, RuntimeError("scheduler closed"))
         if self._thread is None:
             # dispatcher gone (or never started): nothing absorbs
             # rebuilds anymore; a live dispatcher past the bounded

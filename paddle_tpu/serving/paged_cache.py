@@ -48,6 +48,7 @@ import itertools
 import numpy as np
 
 from ..observability import metrics as _metrics
+from ..observability import request_trace as _rtrace
 
 __all__ = ["BlockPool", "PrefixIndex", "PoolExhausted"]
 
@@ -125,6 +126,11 @@ class BlockPool:
     def alloc(self):
         """One fresh block with refcount 1 (the caller's)."""
         if not self._free:
+            # pool pressure is a per-request fate decision (starve /
+            # preempt / park) — annotate the active request's trace
+            _rtrace.global_event("poolExhausted",
+                                 num_blocks=self.num_blocks,
+                                 block_size=self.block_size)
             raise PoolExhausted(
                 "all %d blocks referenced (%d-row blocks)"
                 % (self.num_blocks, self.block_size))
@@ -360,6 +366,7 @@ class PrefixIndex:
             if block is not None and self.pool.refcount(block) == 1:
                 self._drop(key)
                 POOL_EVICTIONS.inc()
+                _rtrace.global_event("prefixEvict", block=int(block))
                 return True
         return False
 

@@ -30,7 +30,9 @@ default healthy path stays one flag check per request.
 import threading
 import time
 
+from ..observability import flight as _flight
 from ..observability import metrics as _metrics
+from ..observability import request_trace as _rtrace
 from ..utils import log as _log
 
 __all__ = ["ServingDeadlineError", "ServingTimeoutError",
@@ -154,6 +156,13 @@ class ReplicaBreaker:
                 1 if new_state == "closed" else 0)
         _log.structured("serving_breaker", replica=self.index,
                         state=new_state, failures=self.failures)
+        # a transition lands on the request being served (it caused
+        # it) when tracing sampled one, always on the flight ring when
+        # armed — in-memory appends, safe under the breaker lock the
+        # callers hold. The flight DUMP (registry snapshot + file
+        # write) is NOT: record_failure fires it after release.
+        _rtrace.global_event("breakerTransition", replica=self.label,
+                            state=new_state, failures=self.failures)
 
     def record_success(self):
         with self._lock:
@@ -162,13 +171,24 @@ class ReplicaBreaker:
                 self._transition("closed")
 
     def record_failure(self, hang=False):
+        opened = False
         with self._lock:
             self.failures += 1
             if (hang or self.state == "half_open"
                     or self.failures >= self.threshold):
                 if self.state != "open":
                     self._transition("open")
+                    opened = True
                 self.opened_at = time.monotonic()
+        if opened:
+            # incident-grade: snapshot the flight ring while the
+            # lead-up events are still in it. Async — outside the
+            # lock AND off this thread: record_failure runs on the
+            # serving/generation dispatchers, which must not stall
+            # behind a registry serialize + disk write mid-incident.
+            _flight.RECORDER.trigger_async("breaker_open",
+                                           replica=self.label,
+                                           failures=self.failures)
 
     def ready_to_probe(self, now=None):
         if self.state != "open":

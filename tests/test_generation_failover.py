@@ -426,6 +426,60 @@ class TestGaugeNamespace:
         assert label_a not in labels and label_b not in labels
 
 
+# -- trace propagation under chaos (ISSUE 12) ------------------------------
+
+class TestTracePropagation:
+    def test_replay_spans_share_one_trace_id_across_sessions(self):
+        """The tentpole contract under chaos: a replayed request's
+        whole life — submit, prefill on the failed session, the
+        failover hop, replay re-admission on the healthy session,
+        resolution — is ONE trace id; the span tree names both
+        sessions on either side of the hop."""
+        from paddle_tpu.observability import request_trace as rtrace
+        scope = _lm_scope()
+        want = _baseline(scope)
+        ptpu.config.set_flags(request_tracing=True)
+        rtrace.clear()
+        sched = GenerationScheduler(
+            [_session(scope), _session(scope)], replay_attempts=4,
+            breaker_failures=1, breaker_cooldown_ms=60000.0)
+        try:
+            faults.arm("generation_step_fail", at=0, times=1)
+            futs = [sched.submit(list(p), max_new_tokens=6, eos_id=-1)
+                    for p in PROMPTS]
+            got = [[int(t) for t in f.result(timeout=60)] for f in futs]
+            assert got == want  # tracing armed changes no tokens
+        finally:
+            faults.disarm()
+            sched.close()
+            ptpu.config.set_flags(request_tracing=False)
+        assert len(rtrace.trace_ids()) == len(PROMPTS)
+        replayed = []
+        for tid in rtrace.trace_ids():
+            events = rtrace.trace_events(tid)
+            # every span of a request carries its ONE trace id
+            assert all(e["trace_id"] == tid for e in events)
+            names = [e["name"] for e in events]
+            if "failoverRequeue" not in names:
+                continue
+            replayed.append(tid)
+            # the hop: prefill on the session that then failed,
+            # replayAdmit on a different (healthy) one — both under
+            # the same trace id
+            pre = next(e for e in events if e["name"] == "prefill")
+            fail = next(e for e in events
+                        if e["name"] == "sessionFailure")
+            hop = next(e for e in events
+                       if e["name"] == "replayAdmit")
+            assert fail["attrs"]["session"] \
+                == pre["attrs"]["session"] == 0
+            assert hop["attrs"]["session"] != 0
+            assert hop["attrs"]["journal_len"] >= 2
+            assert names.index("failoverRequeue") \
+                < names.index("replayAdmit") < names.index("resolve")
+        assert replayed, "the injected fault replayed no request"
+
+
 # -- default-off guarantees ------------------------------------------------
 
 class TestDefaultOff:
@@ -434,6 +488,8 @@ class TestDefaultOff:
         assert ptpu.config.get_flag("generation_rebuild_limit") == 0
         assert ptpu.config.get_flag("generation_step_timeout_ms") == 0
         assert ptpu.config.get_flag("compile_cache_max_bytes") == 0
+        assert ptpu.config.get_flag("request_tracing") is False
+        assert ptpu.config.get_flag("telemetry_port") == 0
 
     def test_dispatcher_hot_path_reads_no_flags(self, monkeypatch):
         """Acceptance: with the flags at defaults the dispatcher loop
@@ -465,13 +521,23 @@ class TestDefaultOff:
             # the recovery flags are construction-only reads: the
             # per-tick reads are exactly the pre-recovery set (the
             # executor's trace-time cache-key flags plus the
-            # fault_injection master switch in fire_point)
+            # fault_injection master switch in fire_point). The
+            # ISSUE-12 tracing flags never appear either — mint/event
+            # sites gate on module state the config hook syncs, so
+            # request_tracing off keeps this count byte-identical.
             assert not [c for c in calls
                         if c.startswith(("generation_",
-                                         "compile_cache_max"))]
+                                         "compile_cache_max",
+                                         "request_tracing",
+                                         "trace_sample_rate",
+                                         "telemetry_port",
+                                         "flight_dir"))]
             workers = [t for t in threading.enumerate()
                        if t.name.startswith("generation-step-")]
             assert not workers
+            # and no span was recorded anywhere along the way
+            from paddle_tpu.observability import request_trace as rtr
+            assert not rtr.enabled()
         finally:
             sched.close()
 

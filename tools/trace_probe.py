@@ -1,0 +1,251 @@
+"""Request-tracing probe: one trace id per request, end to end,
+through a live failover — plus the cost of watching.
+
+Headless proof of the ISSUE-12 tentpole, no accelerator, no test
+harness:
+
+1. **Overhead**: the same 12-request generation workload runs with
+   ``request_tracing`` off and on (sample rate 1.0); the delta is the
+   tracing tax on the serving hot path (the bench tripwire watches
+   the same number as ``tracing_overhead_pct``).
+2. **Chaos + introspection**: with a PERSISTENT step fault armed on
+   session 0 (``times=None`` — broken, not glitching) and replay
+   armed, every request completes token-identical to the fault-free
+   baseline; the probe then asks the live introspection server
+   (``telemetry_port`` flag -> ``observability/http.py``) for
+   ``/debug/trace?id=`` of a replayed request and asserts the span
+   tree shows the failover hop: ``sessionFailure`` on the broken
+   session -> ``failoverRequeue`` -> ``replayAdmit`` on the healthy
+   one — one trace id across both sessions.
+3. **Flight recorder**: the breaker opening auto-dumped a bundle;
+   the probe prints its path and re-reads it through
+   ``/debug/flight``.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/trace_probe.py
+"""
+
+import json
+import os
+import socket
+import sys
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+VOCAB = 64
+# big enough that a CPU decode step is ~ms-scale — the shape tracing
+# overhead is actually paid against in serving (a 64-wide toy step is
+# ~300us, where 10us of event recording reads as a scary percentage
+# that no real deployment would see)
+KW = dict(d_model=128, num_heads=4, d_ff=256, num_layers=2)
+BOS, EOS = 0, 1
+N_REQUESTS = 12
+MAX_NEW = 12
+MAX_LEN = 48
+PROMPT_BUCKETS = (8, 16, 32)
+SLOTS = 4
+
+
+def build_scope():
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+    from paddle_tpu.models.transformer import transformer_lm
+
+    with ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            toks = layers.data("toks", shape=[1, MAX_LEN], dtype="int64",
+                               append_batch_size=False)
+            lbls = layers.data("lbls", shape=[1, MAX_LEN], dtype="int64",
+                               append_batch_size=False)
+            transformer_lm(toks, lbls, vocab_size=VOCAB, is_test=True,
+                           **KW)
+    exe = ptpu.Executor()
+    scope = ptpu.Scope()
+    with ptpu.scope_guard(scope):
+        exe.run(startup)
+    rs = np.random.RandomState(7)
+    for n in sorted(scope.var_names()):
+        cur = np.asarray(scope.find_var(n))
+        scope.set_var(n, rs.standard_normal(cur.shape).astype(cur.dtype))
+    return scope
+
+
+def make_session(scope):
+    from paddle_tpu.models.transformer import transformer_lm_session
+    from paddle_tpu.serving.generation import GenerationSession
+
+    spec = transformer_lm_session(
+        VOCAB, max_len=MAX_LEN, slots=SLOTS, cache_len=MAX_LEN,
+        prompt_buckets=PROMPT_BUCKETS, bos_id=BOS, eos_id=EOS, **KW)
+    sess = GenerationSession(spec, scope=scope)
+    sess.generate([BOS], max_new_tokens=2, eos_id=-1)  # warm compiles
+    return sess
+
+
+def prompts():
+    rs = np.random.RandomState(11)
+    return [[BOS] + list(rs.randint(2, VOCAB, size=1 + (i % 5)))
+            for i in range(N_REQUESTS)]
+
+
+def run_workload(sched):
+    futs = [sched.submit(p, max_new_tokens=MAX_NEW, eos_id=-1)
+            for p in prompts()]
+    return [[int(t) for t in f.result(timeout=120)] for f in futs]
+
+
+def measure_overhead(scope, rounds=7):
+    """Tracing-on vs tracing-off wall time of the 12-request workload,
+    INTERLEAVED on one warmed scheduler: off/on alternate within each
+    round, so thermal/cache drift between early and late repeats
+    cancels instead of masquerading as (or hiding) the tracing tax.
+    Returns (median_off, median_on, outputs) — outputs asserted
+    identical across modes, because tracing must never change
+    tokens."""
+    import paddle_tpu as ptpu
+    from paddle_tpu.serving.generation import GenerationScheduler
+
+    sched = GenerationScheduler([make_session(scope),
+                                 make_session(scope)])
+    try:
+        run_workload(sched)  # warm the scheduler path itself
+        t_off, t_on = [], []
+        out = None
+        for _ in range(rounds):
+            ptpu.config.set_flags(request_tracing=False)
+            t0 = time.perf_counter()
+            out_off = run_workload(sched)
+            t_off.append(time.perf_counter() - t0)
+            ptpu.config.set_flags(request_tracing=True,
+                                  trace_sample_rate=1.0)
+            t0 = time.perf_counter()
+            out = run_workload(sched)
+            t_on.append(time.perf_counter() - t0)
+            assert out == out_off, "tracing changed tokens"
+        return float(np.median(t_off)), float(np.median(t_on)), out
+    finally:
+        sched.close()
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def http_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.load(resp)
+
+
+def main():
+    import paddle_tpu as ptpu
+    from paddle_tpu.observability import flight
+    from paddle_tpu.observability import request_trace as rtrace
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving.generation import GenerationScheduler
+
+    scope = build_scope()
+
+    # -- 1. overhead: same workload, tracing off vs on (interleaved) ----
+    t_off, t_on, base = measure_overhead(scope)
+    overhead_pct = (t_on - t_off) / t_off * 100.0
+    print(json.dumps({"probe": "tracing_overhead",
+                      "t_off_s": round(t_off, 4),
+                      "t_on_s": round(t_on, 4),
+                      "overhead_pct": round(overhead_pct, 2)}),
+          flush=True)
+
+    # -- 2. chaos run: persistent step fault + replay + live scrape -----
+    port = free_port()
+    ptpu.config.set_flags(telemetry_port=port)
+    flight.RECORDER.min_interval_sec = 0.0
+    rtrace.clear()
+    base_url = "http://127.0.0.1:%d" % port
+    sched = GenerationScheduler(
+        [make_session(scope), make_session(scope)],
+        replay_attempts=4, breaker_failures=1,
+        breaker_cooldown_ms=60000.0)
+    try:
+        faults.arm("generation_step_fail", at=0, times=None)  # broken
+        got = run_workload(sched)
+    finally:
+        faults.disarm()
+        sched.close()
+    assert got == base, "chaos run must be token-identical (got %r)" \
+        % (got,)
+    health = http_json(base_url + "/healthz")
+
+    # find a replayed request and scrape ITS span tree off the wire
+    replayed = None
+    for tid in rtrace.trace_ids():
+        names = [e["name"] for e in rtrace.trace_events(tid) or ()]
+        if "failoverRequeue" in names:
+            replayed = tid
+            break
+    assert replayed is not None, "no request replayed — fault not hit?"
+    tree = http_json(base_url + "/debug/trace?id=" + replayed)
+
+    def walk(node):
+        yield node
+        for child in node.get("children", ()):
+            for n in walk(child):
+                yield n
+
+    events = list(walk(tree["root"]))
+    by_name = {}
+    for ev in events:
+        by_name.setdefault(ev["name"], []).append(ev)
+    assert all(ev.get("trace_id") in (replayed, None)
+               for ev in events), "span tree mixed trace ids"
+    fail = by_name["sessionFailure"][0]["attrs"]
+    hop = by_name["replayAdmit"][0]["attrs"]
+    assert fail["session"] != hop["session"], \
+        "failover hop must cross sessions (%r -> %r)" % (fail, hop)
+    assert "failoverRequeue" in by_name and "resolve" in by_name
+    print(json.dumps({
+        "probe": "failover_trace", "trace_id": replayed,
+        "events": tree["events"],
+        "hop": {"from_session": fail["session"],
+                "to_session": hop["session"],
+                "journal_len": hop["journal_len"]},
+        "span_names": sorted(by_name),
+        "healthz": health["status"]}), flush=True)
+
+    # -- 3. flight recorder ---------------------------------------------
+    # the breaker-open dump runs on a background thread (the
+    # dispatcher must not stall behind the disk write) — give it a
+    # moment to land before scraping
+    deadline = time.monotonic() + 10
+    while flight.RECORDER.latest() is None and \
+            time.monotonic() < deadline:
+        time.sleep(0.02)
+    bundle = http_json(base_url + "/debug/flight")
+    print(json.dumps({
+        "probe": "flight_recorder",
+        "dump_path": flight.RECORDER.last_dump_path,
+        "reason": bundle["reason"],
+        "ring_events": len(bundle["events"]),
+        "config_fingerprint_keys": len(bundle["config"])}), flush=True)
+    assert flight.RECORDER.last_dump_path and \
+        os.path.exists(flight.RECORDER.last_dump_path)
+
+    ptpu.config.set_flags(request_tracing=False, telemetry_port=0)
+    print(json.dumps({"probe": "trace_probe", "ok": True,
+                      "requests": N_REQUESTS,
+                      "overhead_pct": round(overhead_pct, 2),
+                      "flight_dump": flight.RECORDER.last_dump_path}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
