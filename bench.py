@@ -25,6 +25,7 @@ import glob
 import json
 import os
 import re
+import subprocess
 import sys
 import time
 
@@ -1009,6 +1010,196 @@ def bench_tracing_overhead(on_accel):
     }
 
 
+def bench_fleet(on_accel):
+    """Serving-fleet latencies (ISSUE 13), all tripwired: p99 request
+    latency with one of two engine-worker PROCESSES SIGKILLed
+    mid-generation (the router re-drives its journals on the peer —
+    the bench RAISES on any client error or any token diverging from
+    the fault-free baseline, so the zero-error/bit-identical contract
+    is load-bearing, not just asserted in tests), cold-member
+    scale-up measured as spawn-to-first-token against the warm
+    persistent compile cache (PR 7), and the client-error count of a
+    rolling deploy under concurrent traffic — which must be 0 (the
+    bench raises otherwise; the metric line documents it)."""
+    import tempfile
+    import threading
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    import fleet_worker_child as child
+    from paddle_tpu.serving import wire
+    from paddle_tpu.serving.fleet import FleetRouter
+
+    suffix = "" if on_accel else "_cpu_smoke"
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+    cache_dir = os.path.join(tmp, "compile_cache")
+    n_req, max_new = 12, 10
+    prompts = child.chaos_prompts(n_req, seed=5)
+
+    scope = child.build_scope(seed=7)
+    np.savez(os.path.join(tmp, "v1.npz"),
+             **child.model_params(scope, 1.01))
+    sched = child.make_scheduler(scope, slots=4)
+    futs = [sched.submit(p, max_new_tokens=max_new, eos_id=-1)
+            for p in prompts]
+    baseline = [[int(t) for t in f.result(timeout=300)] for f in futs]
+    sched.close()
+
+    def spawn(router, mid, *extra):
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "fleet_worker_child.py"),
+             "--router", "%s:%d" % router.addr, "--member", mid,
+             "--heartbeat-ms", "150", "--compile-cache", cache_dir]
+            + list(extra),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        line = proc.stdout.readline().strip()
+        if not line.startswith("READY"):
+            proc.kill()
+            raise RuntimeError("fleet worker failed: %r" % line)
+        return proc, int(line.split()[2])
+
+    router = FleetRouter(heartbeat_timeout_ms=700, replay_attempts=6,
+                         breaker_failures=2,
+                         breaker_cooldown_ms=60000.0)
+    procs = []
+    try:
+        procs.append(spawn(router, "m0", "--kill-at-token", "4")[0])
+        procs.append(spawn(router, "m1")[0])
+        router.wait_members(2, timeout=300)
+
+        # p99 under a mid-generation SIGKILL of m0
+        done_at = {}
+        t0 = time.perf_counter()
+        futures = []
+        for i, p in enumerate(prompts):
+            fut = router.submit(p, max_new_tokens=max_new, eos_id=-1,
+                                meta=True)
+            fut.add_done_callback(
+                lambda f, i=i: done_at.__setitem__(
+                    i, time.perf_counter()))
+            futures.append(fut)
+        results = [f.result(timeout=300) for f in futures]
+        # done-callbacks run AFTER result() waiters wake (Future
+        # internals), so the last stamp can trail the collection
+        # loop by a beat — wait them in, bounded
+        wait_deadline = time.monotonic() + 10
+        while len(done_at) < n_req and \
+                time.monotonic() < wait_deadline:
+            time.sleep(0.005)
+        if len(done_at) < n_req:
+            raise RuntimeError("missing completion stamps: %d/%d"
+                               % (len(done_at), n_req))
+        lat_ms = [(done_at[i] - t0) * 1e3 for i in range(n_req)]
+        mism = [i for i, (got, want) in enumerate(zip(results,
+                                                      baseline))
+                if got["tokens"].tolist() != want]
+        if mism:
+            raise RuntimeError("fleet failover diverged from the "
+                               "fault-free baseline: %r" % mism)
+        if procs[0].poll() is None:
+            raise RuntimeError("worker m0 was never killed")
+        p99_kill = float(np.percentile(lat_ms, 99))
+
+        # cold-member scale-up: spawn-to-first-token (warm cache)
+        t_up0 = time.perf_counter()
+        proc2, port2 = spawn(router, "m2")
+        procs.append(proc2)
+        conn = wire.LineConn.connect(("127.0.0.1", port2),
+                                     timeout=300.0)
+        conn.send({"cmd": "generate", "prompt": prompts[0],
+                   "max_new": 2, "eos_id": -1})
+        first_token_ms = None
+        while True:
+            msg = conn.recv()
+            if msg is None or msg.get("ev") == "err":
+                raise RuntimeError("scale-up member failed: %r" % msg)
+            if msg.get("ev") == "tok" and first_token_ms is None:
+                first_token_ms = (time.perf_counter() - t_up0) * 1e3
+            if msg.get("ev") == "done":
+                break
+        conn.close()
+
+        # rolling deploy under concurrent traffic: client errors
+        # MUST be zero (canary failures replay onto stable members)
+        stop = threading.Event()
+        responses, errors = [], []
+
+        def traffic():
+            rs = np.random.RandomState(17)
+            while not stop.is_set():
+                p = [child.BOS] + [int(t) for t in
+                                   rs.randint(2, child.VOCAB, 3)]
+                try:
+                    responses.append(router.submit(
+                        p, max_new_tokens=4, eos_id=-1,
+                        meta=True).result(timeout=120))
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(repr(exc))
+        threads = [threading.Thread(target=traffic, daemon=True)
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        deploy = router.rolling_deploy(
+            params_path=os.path.join(tmp, "v1.npz"), tag="v1",
+            canary_requests=2, watch_timeout=120)
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        if not deploy.get("ok"):
+            raise RuntimeError("rolling deploy failed: %r" % deploy)
+        mixed = [r for r in responses
+                 if r["version_start"] != r["version"]]
+        if errors or mixed:
+            raise RuntimeError(
+                "rolling deploy broke the zero-error/one-version "
+                "contract: errors=%r mixed=%d"
+                % (errors[:3], len(mixed)))
+    finally:
+        router.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait()
+
+    return [{
+        "metric": "fleet_p99_under_kill_ms" + suffix,
+        "value": round(p99_kill, 1),
+        "unit": "ms p99 request latency, 1 of 2 workers SIGKILLed "
+                "mid-generation (%d concurrent requests, journal "
+                "re-drive on the peer)" % n_req,
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        # connect-retry + heartbeat-deadline policy waits dominate
+        # the tail on CPU; only a recovery-path blowup should trip
+        "regression_floor": 500.0,
+    }, {
+        "metric": "scale_up_to_first_token_ms" + suffix,
+        "value": round(first_token_ms, 1),
+        "unit": "ms from worker-process spawn to its first generated "
+                "token (persistent compile cache warm)",
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        # interpreter + jax import dominates on CPU; the wire exists
+        # to catch a cold-start (cache/AOT) regression, not import
+        # jitter
+        "regression_floor": 1500.0,
+    }, {
+        "metric": "rolling_deploy_client_errors" + suffix,
+        "value": len(errors),
+        "unit": "client-visible errors during a rolling deploy under "
+                "concurrent traffic (MUST be 0 — the bench raises "
+                "otherwise)",
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        "responses_during_deploy": len(responses),
+        "must_be_zero": True,
+    }]
+
+
 def bench_elastic_resume():
     """Measure the elastic control plane's recovery latency on this
     host: a registered peer goes silent, the master declares it dead
@@ -1141,7 +1332,9 @@ def main():
             ("generation_failover_recovery_ms",
              lambda: bench_generation_failover(on_accel)),
             ("tracing_overhead_pct",
-             lambda: bench_tracing_overhead(on_accel))]:
+             lambda: bench_tracing_overhead(on_accel)),
+            ("fleet_p99_under_kill_ms",
+             lambda: bench_fleet(on_accel))]:
         try:
             out = _isolated(fn)
             for line in (out if isinstance(out, list) else [out]):

@@ -404,11 +404,19 @@ void serve_conn(Master* m, int fd) {
       if (!line.empty() && line.back() == '\r') line.pop_back();
       std::string resp = m->handle(line) + "\n";
       if (send(fd, resp.data(), resp.size(), MSG_NOSIGNAL) < 0) {
+        shutdown(fd, SHUT_RDWR);
         close(fd);
         return;
       }
     }
   }
+  // shutdown BEFORE close: close() alone only drops this process's
+  // reference — a client blocked in recv() on the other end may sit
+  // out its full socket timeout before noticing. SHUT_RDWR forces the
+  // FIN onto the wire now, so a graceful stop() unblocks every
+  // drained client immediately (every fleet/elastic test teardown
+  // otherwise eats the timeout).
+  shutdown(fd, SHUT_RDWR);
   close(fd);
 }
 

@@ -232,6 +232,24 @@ telemetry_port: 0 (default) = no introspection server. N = serve
 flight_dir: where flight-recorder bundles are dumped (None = default
   <tempdir>/paddle_tpu_flight). Bundles are bounded to the newest
   FlightRecorder.max_dumps files; read only at dump time.
+
+fleet_heartbeat_ms: cadence of an EngineWorker's membership beats to
+  its FleetRouter (serving/fleet.py); the router's default member
+  deadline is 3x this, so one delayed beat is never a declared death
+  (the PR-6 rule at the serving tier). Read only inside the fleet
+  constructors — the default flags construct no router, no worker, no
+  sockets, and no threads, and nothing on the single-process serving
+  path reads any fleet_* flag.
+
+fleet_members_min: how many live members a router considers a healthy
+  fleet: the /healthz threshold and the ``wait_members`` rendezvous
+  default. Routing itself degrades gracefully below it (whoever is
+  alive serves). Read only at router construction.
+
+fleet_canary_fraction: the share of live traffic a freshly-swapped
+  member receives during a rolling deploy's canary watch (the rest of
+  the fleet keeps serving the stable version). Read only at router
+  construction.
 """
 
 import jax
@@ -295,6 +313,12 @@ _flags = {
     "trace_sample_rate": 1.0,
     "telemetry_port": 0,
     "flight_dir": None,
+    # serving fleet (serving/fleet.py; read only inside FleetRouter /
+    # EngineWorker constructors — defaults construct no router, no
+    # sockets, no threads anywhere)
+    "fleet_heartbeat_ms": 1000.0,
+    "fleet_members_min": 1,
+    "fleet_canary_fraction": 0.25,
 }
 
 # Observers called with the flag dict after every set_flags (the

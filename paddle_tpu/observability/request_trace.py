@@ -59,7 +59,8 @@ import time
 from . import flight as _flight
 from . import metrics as _metrics
 
-__all__ = ["TraceContext", "NO_TRACE", "mint", "event", "global_event",
+__all__ = ["TraceContext", "NO_TRACE", "mint", "adopt", "event",
+           "global_event",
            "discard", "current", "activate", "trace_events", "span_tree",
            "trace_ids", "enabled", "clear", "QUEUE_WAIT_MS",
            "PREFILL_MS", "DECODE_STEP_MS", "REPLAY_RECOVERY_MS",
@@ -191,6 +192,18 @@ class RequestTracer:
         # than a formatted string on the per-token event path
         return next(self._span_seq)
 
+    def _ensure_trace_locked(self, trace_id):
+        """Register ``trace_id`` in the bounded store (caller holds
+        the lock) — the ONE place the store-insertion/eviction policy
+        lives, shared by mint() and adopt()."""
+        rec = self._traces.get(trace_id)
+        if rec is None:
+            rec = {"events": [], "dropped": 0}
+            self._traces[trace_id] = rec
+            while len(self._traces) > self.MAX_TRACES:
+                self._traces.popitem(last=False)
+        return rec
+
     def mint(self, kind, **baggage):
         """A fresh TraceContext for one request (with its root event),
         or None when tracing is off / the request was not sampled —
@@ -206,13 +219,29 @@ class RequestTracer:
             # silently merge two requests' span trees)
             trace_id = "t%016x" % self._rand.getrandbits(64)
             span_id = self._new_span_id()
-            rec = {"events": [], "dropped": 0}
-            self._traces[trace_id] = rec
-            while len(self._traces) > self.MAX_TRACES:
-                self._traces.popitem(last=False)
+            self._ensure_trace_locked(trace_id)
         ctx = TraceContext(trace_id, span_id, dict(baggage))
         self._record(ctx, span_id, None, "request", None,
                      dict(baggage, kind=kind))
+        return ctx
+
+    def adopt(self, trace_id, kind, **baggage):
+        """A TraceContext bound to a trace id minted in ANOTHER
+        process (wire propagation: the fleet router sends its id in
+        the request envelope; the worker adopts it, so both stores
+        grow the same tree). No sampling decision here — the minting
+        side already made it, and the id's presence on the wire IS
+        that decision. Returns None when tracing is off locally or
+        ``trace_id`` is falsy; otherwise registers the trace (if
+        unseen) and roots a ``kind`` span in it."""
+        if not self.enabled or not trace_id:
+            return None
+        with self._lock:
+            self._ensure_trace_locked(trace_id)
+            span_id = self._new_span_id()
+        ctx = TraceContext(trace_id, span_id, dict(baggage))
+        self._record(ctx, span_id, None, kind, None,
+                     dict(baggage, kind=kind, adopted=True))
         return ctx
 
     def _record(self, ctx, span_id, parent_id, name, dur_ms, attrs):
@@ -343,6 +372,10 @@ _TRACER = RequestTracer()
 
 def mint(kind, **baggage):
     return _TRACER.mint(kind, **baggage)
+
+
+def adopt(trace_id, kind, **baggage):
+    return _TRACER.adopt(trace_id, kind, **baggage)
 
 
 def event(ctx, name, dur_ms=None, parent=None, **attrs):

@@ -81,6 +81,24 @@ site                          where / what
                               ``generation_step_timeout_ms`` to simulate a
                               wedged decode step; only the step-timeout
                               escalation gets the dispatcher out
+``fleet_member_kill``         EngineWorker token-stream loop — ``index``
+                              is the per-request streamed-token count;
+                              arm with ``action="kill"`` (in the WORKER
+                              process) to SIGKILL the member
+                              mid-generation: the router re-drives its
+                              in-flight journals on a peer
+``fleet_network_partition``   both ends of the fleet wire: the router
+                              fires it before dispatching to a member
+                              (``index`` = member id, default exception
+                              ConnectionError) and the worker's heartbeat
+                              loop SWALLOWS beats under the same site —
+                              one arm per process simulates the matching
+                              direction of a partition
+``fleet_slow_member``         EngineWorker, before serving a request —
+                              ``index`` is the member id; arm with
+                              ``action="callback"`` sleeping past the
+                              router's ``call_timeout`` to simulate a
+                              wedged member (hang = instant breaker open)
 ============================  =============================================
 
 Actions: ``"raise"`` (raise ``exc``, default :class:`InjectedFault`),

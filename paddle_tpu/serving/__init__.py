@@ -36,6 +36,13 @@ Four cooperating pieces:
   allocator with refcounts over the per-layer K/V pools) and
   :class:`PrefixIndex` (content-hashed prompt caching: shared prefix
   blocks, copy-on-write divergence, LRU eviction under pressure).
+* :mod:`fleet` — the multi-process tier: :class:`FleetRouter`
+  (line-protocol membership with heartbeats and generation fencing,
+  least-loaded routing over per-member breakers, cross-process
+  token-replay failover, rolling deploys with canary watch and
+  fleet-wide rollback) and :class:`EngineWorker` (the process wrapper
+  a member runs, streaming tokens over ``wire.py``'s JSON-line
+  transport).
 
 Everything is instrumented through :mod:`paddle_tpu.observability`;
 ``tools/serving_probe.py`` exercises the stack headless and
@@ -57,11 +64,14 @@ from .generation import (GenerationScheduler,  # noqa: F401
                          GenerationSession, GenerationSpec)
 from .paged_cache import (BlockPool, PoolExhausted,  # noqa: F401
                           PrefixIndex)
+from .fleet import EngineWorker, FleetRouter  # noqa: F401
+from .wire import WireError  # noqa: F401
 
 __all__ = ["ServingEngine", "MicroBatcher", "ServingOverloadError",
            "ServingDeadlineError", "ServingTimeoutError",
            "ServingUnavailableError", "SwapRejectedError",
            "ReplicaBreaker", "GenerationSession", "GenerationScheduler",
            "GenerationSpec", "BlockPool", "PrefixIndex",
-           "PoolExhausted", "deploy", "generation", "paged_cache",
-           "quant", "resilience"]
+           "PoolExhausted", "FleetRouter", "EngineWorker", "WireError",
+           "deploy", "fleet", "generation", "paged_cache",
+           "quant", "resilience", "wire"]

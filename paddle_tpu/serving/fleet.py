@@ -1,0 +1,1422 @@
+"""Serving fleet: routed multi-process inference with membership,
+cross-process failover, and rolling deploys.
+
+Everything serving-side so far — engine replicas, the generation
+scheduler, breakers, token-replay failover, hot swap — lives inside
+one process. This module is the tier above, the seam the reference
+system built its whole distributed runtime for (PAPER.md §2): one
+process's death must be an event the fleet absorbs, not an outage.
+
+* :class:`FleetRouter` — the front door over N engine *processes*:
+
+  - **membership** on the task-master discipline (PR 6): workers
+    REGister and heartbeat over the line protocol; a missed deadline
+    drops the member, bumps the fleet *generation*, and fences what
+    the dead member still says — a reply landing after its member was
+    declared dead is discarded and the request re-driven, never
+    trusted (``paddle_fleet_fenced_replies_total``). A genuinely new
+    member joining also bumps the generation, so stale world views
+    are always fenced into a re-register.
+  - **routing**: least-loaded placement over healthy members with a
+    per-member :class:`~paddle_tpu.serving.resilience.ReplicaBreaker`
+    (PR 5's breaker promoted one tier up: closed -> open on
+    consecutive failures or a single hang, cooldown-gated trial
+    re-admission), member-labelled gauges, and request-latency
+    histograms.
+  - **cross-process failover**: the router journals ``prompt ⊕
+    tokens-so-far`` per request (workers stream each token back), so
+    a killed member's in-flight generations re-drive on a peer by
+    re-submitting the journal — exactly the PR-9 replay path, one
+    process up: the peer prefills the history and greedy decoding
+    continues token-for-token identical to a fault-free run. A
+    journal is only reusable on a peer serving the SAME weights
+    version; across versions it is discarded and the generation
+    restarts from the prompt (mixed-version output would be neither
+    version's answer).
+  - **rolling deploys**: drain one member, ``swap`` it (the worker
+    applies the push through the PR-7/PR-9 swap gates), canary-scope
+    a fraction of live traffic to it, watch; a watch failure rolls
+    the WHOLE fleet back to the prior version and aborts. Clients
+    see zero errors either way — canary failures replay onto stable
+    members.
+
+* :class:`EngineWorker` — the process wrapper a member runs: serves a
+  local :class:`~paddle_tpu.serving.generation.GenerationScheduler`
+  (or a stateless :class:`~paddle_tpu.serving.engine.ServingEngine`)
+  over the JSON-line wire (``serving/wire.py``: length-capped reads,
+  per-call timeouts, jittered retry), registers with the router,
+  heartbeats on ``fleet_heartbeat_ms``, streams tokens as they
+  decode, and answers ``swap``/``rollback``/``health``. Cold members
+  warm through the PR-7 persistent compile cache / AOT artifacts, so
+  scale-up-under-load is scale-up-to-first-token.
+
+Cross-process tracing (PR 12, promoted over the wire): the request
+envelope carries the router-minted trace id; the router stamps a
+``fleetHop`` span per dispatch and a ``memberRecv`` child from the
+worker's ack, so one request killed mid-generation reads router ->
+dead member -> replay-on-peer in a single ``/debug/trace`` tree.
+
+Fault sites (resilience/faults.py): ``fleet_member_kill`` (worker
+side, indexed by streamed-token count — ``action="kill"`` SIGKILLs
+the worker mid-generation), ``fleet_network_partition`` (router side
+before dispatch, indexed by member id — and the worker's heartbeat
+loop swallows beats under the same site, so one arm simulates both
+directions of a partition), ``fleet_slow_member`` (worker side before
+serving, indexed by member id — arm a callback sleeping past the
+router's call timeout).
+
+Default flags construct NONE of this: no router, no worker, no
+sockets, no threads. ``fleet_heartbeat_ms`` / ``fleet_members_min`` /
+``fleet_canary_fraction`` are read only inside these constructors —
+single-process serving behavior and hot-path flag-check counts are
+byte-identical with the fleet unused.
+"""
+
+import itertools
+import json
+import os
+import queue
+import threading
+import time
+import weakref
+from concurrent.futures import Future
+
+import numpy as np
+
+from .. import config as _config
+from ..observability import flight as _flight
+from ..observability import metrics as _metrics
+from ..observability import request_trace as _rtrace
+from ..resilience import faults as _faults
+from ..utils import log as _log
+from . import resilience as _sres
+from . import wire as _wire
+from .batcher import _resolve
+from .resilience import (ReplicaBreaker, ServingDeadlineError,
+                         ServingUnavailableError)
+
+__all__ = ["FleetRouter", "EngineWorker"]
+
+_REQUESTS = _metrics.REGISTRY.counter(
+    "paddle_fleet_requests_total",
+    "Generation requests accepted by a fleet router")
+_FAILOVERS = _metrics.REGISTRY.counter(
+    "paddle_fleet_failover_total",
+    "Requests re-driven on a peer member after a member failure "
+    "(journal re-submit — the PR-9 replay path, one process up)")
+_DEATHS = _metrics.REGISTRY.counter(
+    "paddle_fleet_member_deaths_total",
+    "Members dropped for a missed heartbeat deadline")
+_FENCED = _metrics.REGISTRY.counter(
+    "paddle_fleet_fenced_replies_total",
+    "Replies discarded because their member had been declared dead "
+    "by the time they landed (generation fencing, serving tier)")
+_JOURNAL_RESETS = _metrics.REGISTRY.counter(
+    "paddle_fleet_journal_resets_total",
+    "Replay journals discarded because the only willing peer served "
+    "a different weights version (the generation restarts from the "
+    "prompt — a mixed-version response is never served)")
+_DEPLOYS = _metrics.REGISTRY.counter(
+    "paddle_fleet_deploys_total",
+    "Rolling deploys by outcome", labelnames=("outcome",))
+_ROLLBACKS = _metrics.REGISTRY.counter(
+    "paddle_fleet_rollbacks_total",
+    "Fleet-wide rollbacks (watch failure or swap failure mid-deploy)")
+_GENERATION = _metrics.REGISTRY.gauge(
+    "paddle_fleet_generation",
+    "Fleet membership generation (bumps on every join/death)",
+    labelnames=("router",))
+_MEMBERS_LIVE = _metrics.REGISTRY.gauge(
+    "paddle_fleet_members_live",
+    "Members currently in the routing rotation",
+    labelnames=("router",))
+_MEMBER_INFLIGHT = _metrics.REGISTRY.gauge(
+    "paddle_fleet_member_inflight",
+    "Requests currently dispatched to the member (least-loaded "
+    "placement key)", labelnames=("member",))
+_REQUEST_MS = _metrics.REGISTRY.histogram(
+    "paddle_fleet_request_ms",
+    "Router submit -> resolution per fleet request (all hops)",
+    buckets=_metrics.LATENCY_MS_BUCKETS)
+_RECOVERY_SECONDS = _metrics.REGISTRY.histogram(
+    "paddle_fleet_recovery_seconds",
+    "Member failure -> first replayed token streaming from a peer "
+    "(kill-to-first-replayed-token)")
+
+_ROUTER_SEQ = itertools.count()
+
+
+class _MemberError(RuntimeError):
+    """A member failed a request server-side (error frame, mid-stream
+    EOF, or a fenced stale reply) — failover material, charged to the
+    member's breaker, never surfaced while replay budget remains."""
+
+
+class _VersionRetry(Exception):
+    """The member's ack revealed a weights version the router's cache
+    didn't know (out-of-band swap, second router deploying): the
+    journal sent with the hop was generated under OTHER weights, so
+    the hop is abandoned and the request retried from the prompt.
+    Not a member failure — no breaker charge, no replay burned."""
+
+
+class _Member:
+    __slots__ = ("id", "addr", "state", "joined_gen", "deadline",
+                 "version", "inflight", "served", "failures",
+                 "breaker", "conns", "label", "index")
+
+    def __init__(self, mid, addr, gen, label, index):
+        self.id = mid
+        self.addr = tuple(addr)
+        self.state = "live"   # live | draining | canary | dead
+        self.joined_gen = gen
+        self.deadline = None  # monotonic heartbeat deadline
+        self.version = None   # last weights tag the member reported
+        self.inflight = 0
+        self.served = 0       # completions since the last swap (watch)
+        self.failures = 0     # failures since the last swap (watch)
+        self.breaker = None
+        self.conns = set()    # open per-request data connections
+        self.label = label    # "f<router>:<member>" — gauge namespace
+        self.index = index    # dense join order (breaker index)
+
+
+class _FleetRequest:
+    __slots__ = ("prompt", "tokens", "max_new", "eos_id", "deadline",
+                 "future", "meta", "ctx", "replays", "charged",
+                 "failed_on", "canary", "tokens_version", "version",
+                 "version_start", "member", "fail_t", "t_submit")
+
+    def __init__(self, prompt, max_new, eos_id, deadline, meta):
+        self.prompt = [int(t) for t in prompt]
+        self.tokens = []          # the replay journal's generated half
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.deadline = deadline  # absolute monotonic, or None
+        self.future = Future()
+        self.meta = meta
+        self.ctx = None
+        self.replays = 0
+        self.charged = False      # at-most-one breaker charge (PR 5/9)
+        self.failed_on = set()    # member ids this request failed on
+        self.canary = None        # pinned canary routing for one hop
+        self.tokens_version = None  # weights tag that produced tokens
+        self.version = None
+        self.version_start = None
+        self.member = None
+        self.fail_t = None        # failure instant, for recovery hist
+        self.t_submit = time.perf_counter()
+
+    def journal(self):
+        return self.prompt + self.tokens
+
+    def remaining(self):
+        if self.max_new is None:
+            return None
+        return max(0, int(self.max_new) - len(self.tokens))
+
+
+class FleetRouter:
+    """Front-end router over N :class:`EngineWorker` processes.
+
+    Construct it, point workers' ``router_addr`` at :attr:`addr`, and
+    ``submit(prompt) -> Future`` routes over whoever is alive. Nothing
+    global is touched at defaults — the fleet flags are read here
+    and in :class:`EngineWorker` only.
+
+    ``heartbeat_timeout_ms`` (default ``3 x fleet_heartbeat_ms``) is
+    the membership deadline; 0 disables reaping (manual membership —
+    unit tests drive deaths explicitly). ``breaker_failures`` defaults
+    to the ``serving_breaker_failures`` flag (0 = no breakers).
+    ``replay_attempts`` bounds cross-process re-drives per request.
+    ``canary_fraction`` (default: the ``fleet_canary_fraction`` flag)
+    is the share of live traffic a mid-deploy canary member receives;
+    ``members_min`` (default: the ``fleet_members_min`` flag) is the
+    /healthz liveness threshold and the ``wait_members`` default.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0,
+                 heartbeat_timeout_ms=None, breaker_failures=None,
+                 breaker_cooldown_ms=None, replay_attempts=3,
+                 call_timeout=120.0, connect_timeout=5.0,
+                 placement_timeout=30.0, canary_fraction=None,
+                 members_min=None):
+        self._rid = next(_ROUTER_SEQ)
+        if heartbeat_timeout_ms is None:
+            heartbeat_timeout_ms = \
+                3.0 * float(_config.get_flag("fleet_heartbeat_ms"))
+        self.heartbeat_timeout = float(heartbeat_timeout_ms) / 1e3
+        if breaker_failures is None:
+            breaker_failures = _config.get_flag(
+                "serving_breaker_failures")
+        self.breaker_failures = int(breaker_failures or 0)
+        if breaker_cooldown_ms is None:
+            breaker_cooldown_ms = _config.get_flag(
+                "serving_breaker_cooldown_ms")
+        self.breaker_cooldown = float(breaker_cooldown_ms) / 1e3
+        self.replay_attempts = int(replay_attempts or 0)
+        self.call_timeout = float(call_timeout)
+        self.connect_timeout = float(connect_timeout)
+        self.placement_timeout = float(placement_timeout)
+        if canary_fraction is None:
+            canary_fraction = _config.get_flag("fleet_canary_fraction")
+        self.canary_fraction = float(canary_fraction)
+        if members_min is None:
+            members_min = _config.get_flag("fleet_members_min")
+        self.members_min = int(members_min)
+        self._members = {}          # member id -> _Member
+        self._generation = 0
+        self._member_seq = itertools.count()
+        self._lock = threading.Lock()
+        self._closed = False
+        self._canary = None         # member id mid-canary, or None
+        self._canary_tick = 0
+        self._deploy_lock = threading.Lock()
+        self._gauge("generation").set(0)
+        self._gauge("live").set(0)
+        self._server = _wire.LineServer(
+            self._control, host=host, port=port,
+            timeout=30.0, name="fleet-router-%d" % self._rid)
+        self._monitor_stop = threading.Event()
+        self._monitor = None
+        if self.heartbeat_timeout > 0:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, daemon=True,
+                name="fleet-monitor-%d" % self._rid)
+            self._monitor.start()
+        from ..observability import health as _health
+        self._health_name = "fleet%d" % self._rid
+        _health.register_health(self._health_name,
+                                _router_health(weakref.ref(self)))
+
+    # -- plumbing ---------------------------------------------------------
+    @property
+    def addr(self):
+        return self._server.addr
+
+    @property
+    def generation(self):
+        return self._generation
+
+    def _gauge(self, which):
+        label = "f%d" % self._rid
+        fam = _GENERATION if which == "generation" else _MEMBERS_LIVE
+        return fam.labels(router=label)
+
+    def _label(self, mid):
+        return "f%d:%s" % (self._rid, mid)
+
+    def _live_locked(self):
+        return [m for m in self._members.values()
+                if m.state in ("live", "draining", "canary")]
+
+    def members_live(self):
+        with self._lock:
+            return sorted(m.id for m in self._live_locked())
+
+    def member_versions(self):
+        with self._lock:
+            return {m.id: m.version for m in self._live_locked()}
+
+    def wait_members(self, n=None, timeout=30.0):
+        """Block until ``n`` members (default ``members_min``) are in
+        rotation — the bring-up rendezvous, fleet tier."""
+        n = self.members_min if n is None else int(n)
+        deadline = time.monotonic() + timeout
+        while True:
+            live = self.members_live()
+            if len(live) >= n:
+                return live
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    "fleet rendezvous timed out: %d of %d members "
+                    "joined (%r)" % (len(live), n, live))
+            time.sleep(0.02)
+
+    # -- membership (control plane) ---------------------------------------
+    def _control(self, conn, msg):
+        cmd = msg.get("cmd")
+        if cmd == "reg":
+            conn.send(self._register(msg))
+        elif cmd == "hb":
+            conn.send(self._heartbeat(msg))
+        elif cmd == "unreg":
+            conn.send(self._unregister(msg))
+        elif cmd == "members":
+            with self._lock:
+                conn.send({"ok": True, "generation": self._generation,
+                           "members": sorted(
+                               m.id for m in self._live_locked())})
+        else:
+            conn.send({"ok": False, "error": "unknown cmd %r" % cmd})
+
+    def _register(self, msg):
+        mid = str(msg.get("member"))
+        addr = msg.get("addr")
+        if not mid or not addr:
+            return {"ok": False, "error": "reg needs member and addr"}
+        with self._lock:
+            if self._closed:
+                return {"ok": False, "error": "router closed"}
+            cur = self._members.get(mid)
+            if cur is not None and cur.state != "dead" \
+                    and cur.addr == tuple(addr):
+                # re-register (restarted heartbeat / GENMISMATCH
+                # recovery): membership unchanged, no bump
+                cur.deadline = time.monotonic() + self.heartbeat_timeout
+                gen = self._generation
+                member = cur
+                fresh = False
+            else:
+                # a genuinely new member (or a dead id returning, or a
+                # relocated address — a new process either way) bumps
+                # the generation so stale world views are fenced
+                self._generation += 1
+                gen = self._generation
+                member = _Member(mid, addr, gen, self._label(mid),
+                                 next(self._member_seq))
+                member.deadline = time.monotonic() + \
+                    self.heartbeat_timeout
+                member.version = msg.get("version")
+                if self.breaker_failures:
+                    member.breaker = ReplicaBreaker(
+                        member.index, self.breaker_failures,
+                        self.breaker_cooldown, label=member.label)
+                self._members[mid] = member
+                fresh = True
+            live = len(self._live_locked())
+            self._gauge("generation").set(self._generation)
+            self._gauge("live").set(live)
+        _MEMBER_INFLIGHT.labels(member=member.label).set(
+            member.inflight)
+        if fresh:
+            _log.structured("fleet_member_joined", member=mid,
+                            generation=gen, live=live,
+                            addr=list(member.addr))
+            _rtrace.global_event("fleetMemberJoin", member=mid,
+                                 generation=gen)
+        return {"ok": True, "generation": gen, "live": live}
+
+    def _heartbeat(self, msg):
+        mid = str(msg.get("member"))
+        gen = msg.get("generation")
+        with self._lock:
+            m = self._members.get(mid)
+            if m is None or m.state == "dead":
+                # a restarted router (or a reaped member): re-register
+                return {"ok": False, "genmismatch": self._generation}
+            # a GENMISMATCH beat still refreshes liveness (PR-6 rule:
+            # the beat proves the process is alive; the fence only
+            # says its world view is stale)
+            m.deadline = time.monotonic() + self.heartbeat_timeout
+            if gen != self._generation:
+                return {"ok": False, "genmismatch": self._generation}
+            return {"ok": True, "generation": self._generation}
+
+    def _unregister(self, msg):
+        mid = str(msg.get("member"))
+        self._drop_member(mid, reason="unregister", death=False)
+        return {"ok": True, "generation": self._generation}
+
+    def _monitor_loop(self):
+        tick = min(0.5, max(0.01, self.heartbeat_timeout / 4.0))
+        while not self._monitor_stop.wait(tick):
+            now = time.monotonic()
+            with self._lock:
+                overdue = [m.id for m in self._members.values()
+                           if m.state != "dead" and m.deadline
+                           is not None and now >= m.deadline]
+            for mid in overdue:
+                self._drop_member(mid, reason="heartbeat_timeout")
+
+    def _drop_member(self, mid, reason, death=True):
+        """Declare ``mid`` dead: bump the generation, retire its
+        gauges (the stale-label sweep), and shut its open request
+        connections down so blocked request threads fail over NOW
+        instead of waiting out their read timeout."""
+        with self._lock:
+            m = self._members.get(mid)
+            if m is None or m.state == "dead":
+                return
+            m.state = "dead"
+            self._generation += 1
+            gen = self._generation
+            conns = list(m.conns)
+            m.conns.clear()
+            live = len(self._live_locked())
+            self._gauge("generation").set(gen)
+            self._gauge("live").set(live)
+        if death:
+            _DEATHS.inc()
+        if m.breaker is not None:
+            m.breaker.retired = True  # no gauge resurrection
+        # stale-label hygiene: every family labelled on this member —
+        # breaker health ("replica") and inflight ("member") — retires
+        # in one sweep per labelname (the PR-12 scheduler-close rule)
+        _metrics.REGISTRY.remove_labeled("replica", value=m.label)
+        _metrics.REGISTRY.remove_labeled("member", value=m.label)
+        _log.structured("fleet_member_dropped", member=mid,
+                        reason=reason, generation=gen, live=live)
+        _rtrace.global_event("fleetMemberDeath", member=mid,
+                             reason=reason, generation=gen)
+        if death:
+            _flight.RECORDER.trigger_async("fleet_member_death",
+                                           member=mid, cause=reason)
+        for conn in conns:
+            conn.close()  # SHUT_RDWR: recv-blocked threads unblock
+
+    # -- request plane ----------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, eos_id=None,
+               deadline_ms=None, meta=False):
+        """Route one generation request over the fleet; returns a
+        Future of the generated ids (int64 array), or — with
+        ``meta=True`` — of ``{"tokens", "version", "version_start",
+        "member", "replays"}`` (the deploy-proof surface: a response
+        is served by exactly one weights version)."""
+        if self._closed:
+            raise RuntimeError("router is closed")
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        deadline = None
+        if deadline_ms:
+            budget = float(deadline_ms) / 1e3
+            if budget < 0:
+                _sres.DEADLINE_EXCEEDED.inc()
+                raise ServingDeadlineError(
+                    "deadline budget %.1f ms already spent"
+                    % float(deadline_ms))
+            deadline = time.monotonic() + budget
+        req = _FleetRequest(prompt, max_new_tokens, eos_id, deadline,
+                            meta)
+        req.ctx = _rtrace.mint("fleet.submit",
+                               prompt_len=int(prompt.size),
+                               router=self._rid)
+        _REQUESTS.inc()
+        threading.Thread(target=self._serve, args=(req,), daemon=True,
+                         name="fleet-request").start()
+        return req.future
+
+    def _resolve_ok(self, req):
+        toks = req.tokens
+        if req.eos_id is not None and toks and toks[-1] == req.eos_id:
+            toks = toks[:-1]
+        e2e = time.perf_counter() - req.t_submit
+        _REQUEST_MS.observe(e2e * 1e3)
+        if req.ctx is not None:
+            _rtrace.event(req.ctx, "resolve", tokens=len(toks),
+                          member=req.member, replays=req.replays,
+                          dur_ms=e2e * 1e3)
+        arr = np.asarray(toks, np.int64)
+        if req.meta:
+            _resolve(req.future, result={
+                "tokens": arr, "version": req.version,
+                "version_start": req.version_start,
+                "member": req.member, "replays": req.replays})
+        else:
+            _resolve(req.future, result=arr)
+
+    def _resolve_err(self, req, exc):
+        if req.ctx is not None:
+            _rtrace.event(req.ctx, "resolveError",
+                          error=repr(exc)[:200],
+                          error_type=type(exc).__name__)
+        _resolve(req.future, exception=exc)
+
+    def _serve(self, req):
+        last_exc = None
+        while True:
+            if req.deadline is not None and \
+                    time.monotonic() >= req.deadline:
+                _sres.DEADLINE_EXCEEDED.inc()
+                if req.ctx is not None:
+                    _rtrace.event(req.ctx, "deadlineExpired",
+                                  replays=req.replays)
+                self._resolve_err(req, ServingDeadlineError(
+                    "fleet deadline expired after %.1f ms"
+                    % ((time.perf_counter() - req.t_submit) * 1e3)))
+                return
+            # a member died between streaming EOS and its done frame:
+            # the journal already ends the generation — serve it
+            # without another hop
+            if req.eos_id is not None and req.tokens and \
+                    req.tokens[-1] == req.eos_id:
+                self._resolve_ok(req)
+                return
+            if req.remaining() == 0:
+                self._resolve_ok(req)
+                return
+            m = self._acquire_member(req)
+            if m is None:
+                self._resolve_err(
+                    req, last_exc if last_exc is not None
+                    else ServingUnavailableError(
+                        "no healthy fleet member"))
+                return
+            try:
+                done = self._run_hop(req, m)
+            except _VersionRetry:
+                # router-side cache staleness, not a member failure:
+                # the journal was reset, retry (from the prompt) with
+                # no breaker charge and no replay burned
+                continue
+            except Exception as exc:
+                # a read past call_timeout is a hang (socket.timeout
+                # is TimeoutError): instant breaker open, the PR-5 rule
+                hang = isinstance(exc, TimeoutError)
+                self._member_failed(req, m, exc, hang=hang)
+                last_exc = exc
+                if req.replays >= self.replay_attempts:
+                    self._resolve_err(req, exc)
+                    return
+                req.replays += 1
+                req.fail_t = time.perf_counter()
+                _FAILOVERS.inc()
+                if req.ctx is not None:
+                    _rtrace.event(req.ctx, "failoverRequeue",
+                                  from_member=m.id,
+                                  replays=req.replays,
+                                  journal_len=len(req.journal()),
+                                  error=repr(exc)[:200])
+                continue
+            if done:
+                return
+
+    def _acquire_member(self, req):
+        """A member to dispatch to (inflight already counted), or
+        None when nothing can take the request within the placement
+        window. Least-loaded among eligible (live, breaker closed —
+        or a cooldown-elapsed trial when nothing fitting is closed);
+        members this request already failed on are last resort; a
+        mid-deploy canary member receives only its traffic fraction."""
+        deadline = time.monotonic() + self.placement_timeout
+        if req.deadline is not None:
+            deadline = min(deadline, req.deadline)
+        while True:
+            if self._closed:
+                return None
+            with self._lock:
+                m = self._pick_locked(req)
+                if m is not None:
+                    m.inflight += 1
+                    _MEMBER_INFLIGHT.labels(member=m.label).set(
+                        m.inflight)
+                    return m
+                anyone = bool(self._live_locked())
+            if self._closed and not anyone:
+                return None
+            if time.monotonic() >= deadline:
+                return None
+            # a breaker cooldown, a draining member, or a scale-up
+            # registration can make someone eligible in finite time
+            time.sleep(0.02)
+
+    def _pick_locked(self, req):
+        live = [m for m in self._members.values()
+                if m.state in ("live", "canary")]
+        if not live:
+            return None
+        canary = self._canary
+        if canary is not None:
+            if req.canary is None:
+                # one routing decision per request: every k-th live
+                # submission is canary-scoped (fraction-approximate,
+                # deterministic — no RNG in the dispatch path)
+                self._canary_tick += 1
+                k = max(1, int(round(1.0 / max(self.canary_fraction,
+                                               1e-6))))
+                req.canary = (self._canary_tick % k) == 0
+            if req.canary and canary not in req.failed_on:
+                live = [m for m in live if m.id == canary] or live
+            else:
+                rest = [m for m in live if m.id != canary]
+                live = rest or live
+        cands = sorted(live, key=lambda m: (m.id in req.failed_on,
+                                            m.inflight, m.index))
+        if not cands:
+            return None
+        now = time.monotonic()
+        # a cooldown-elapsed open breaker gets THIS request as its
+        # trial even while healthy members exist — there is no
+        # background prober at the fleet tier, so live traffic is how
+        # an open member re-enters rotation (at most one trial per
+        # cooldown window: a failed trial re-opens with a fresh one).
+        # Never a request that already failed there.
+        for m in cands:
+            b = m.breaker
+            if b is not None and b.state == "open" \
+                    and b.ready_to_probe(now) \
+                    and m.id not in req.failed_on:
+                b.to_half_open()  # the dispatch IS the trial (PR 5)
+                return m
+        for m in cands:
+            if m.breaker is None or m.breaker.state == "closed":
+                return m
+        for m in cands:
+            if m.breaker.state == "half_open":
+                return m  # nothing closed: trial traffic rides along
+        return None
+
+    def _release_member(self, m):
+        with self._lock:
+            m.inflight = max(0, m.inflight - 1)
+            inflight = m.inflight
+            dead = m.state == "dead"
+        if not dead:
+            _MEMBER_INFLIGHT.labels(member=m.label).set(inflight)
+
+    def _member_failed(self, req, m, exc, hang=False):
+        b = m.breaker
+        if b is not None:
+            was_trial = b.state == "half_open"
+            if hang or was_trial or not req.charged:
+                # at most one charge per request across its replays —
+                # a poison prompt cannot black out the fleet (PR 5/9);
+                # hangs and trial failures always record
+                b.record_failure(hang=hang)
+                req.charged = True
+        req.failed_on.add(m.id)
+        req.canary = False  # a failed canary pin replays on the stable set
+        with self._lock:
+            m.failures += 1
+        _log.structured("fleet_member_failed", member=m.id,
+                        error=repr(exc)[:200], hang=hang,
+                        replays=req.replays)
+
+    def _run_hop(self, req, m):
+        """One dispatch to ``m``: stream tokens into the journal until
+        done. Returns True when the request was RESOLVED (success or a
+        client-shaped error); raises on member failure (failover
+        material). The inflight count is released either way."""
+        try:
+            _faults.fire_point("fleet_network_partition", index=m.id,
+                               default_exc=ConnectionError)
+            if req.tokens and req.tokens_version != m.version:
+                # the journal was generated under different weights:
+                # re-driving it here would splice two versions into
+                # one response. Discard and restart from the prompt —
+                # determinism makes the restart exact, versioning
+                # makes it honest.
+                _JOURNAL_RESETS.inc()
+                if req.ctx is not None:
+                    _rtrace.event(req.ctx, "journalReset",
+                                  from_version=req.tokens_version,
+                                  to_version=m.version,
+                                  discarded=len(req.tokens))
+                req.tokens = []
+            gen_at_dispatch = self._generation
+            hop_span = None
+            if req.ctx is not None:
+                hop_span = _rtrace.event(
+                    req.ctx, "fleetHop", member=m.id,
+                    generation=gen_at_dispatch, attempt=req.replays,
+                    journal_len=len(req.journal()))
+            conn = _wire.LineConn.connect(m.addr,
+                                          timeout=self.connect_timeout)
+            conn.settimeout(self.call_timeout)
+            with self._lock:
+                if m.state == "dead":
+                    conn.close()
+                    raise _MemberError("member %s died before "
+                                       "dispatch" % m.id)
+                m.conns.add(conn)
+            try:
+                remaining_ms = None
+                if req.deadline is not None:
+                    remaining_ms = max(
+                        1.0, (req.deadline - time.monotonic()) * 1e3)
+                conn.send({"cmd": "generate",
+                           "prompt": req.journal(),
+                           "max_new": req.remaining(),
+                           "eos_id": req.eos_id,
+                           "deadline_ms": remaining_ms,
+                           "trace_id": None if req.ctx is None
+                           else req.ctx.trace_id})
+                hop_start = len(req.tokens)
+                while True:
+                    msg = conn.recv()
+                    if msg is None:
+                        raise _MemberError(
+                            "member %s closed mid-request (journal "
+                            "at %d tokens)" % (m.id, len(req.tokens)))
+                    ev = msg.get("ev")
+                    if ev == "ack":
+                        # the version this (possibly replay) hop
+                        # STARTS under; the done frame must match it
+                        # — the exactly-one-version proof surface
+                        ack_version = msg.get("version")
+                        req.version_start = ack_version
+                        if req.eos_id is None and \
+                                msg.get("eos_id") is not None:
+                            req.eos_id = int(msg["eos_id"])
+                        with self._lock:
+                            m.version = ack_version or m.version
+                        if req.tokens and \
+                                req.tokens_version != ack_version:
+                            # the pre-hop check used the router's
+                            # CACHED member version; the ack is
+                            # authoritative (an out-of-band swap can
+                            # stale the cache). The journal already
+                            # went out under the wrong assumption —
+                            # abandon the hop before any of its
+                            # tokens land and retry from the prompt:
+                            # a mixed-version response is never
+                            # served, whoever swapped the member.
+                            _JOURNAL_RESETS.inc()
+                            if req.ctx is not None:
+                                _rtrace.event(
+                                    req.ctx, "journalReset",
+                                    from_version=req.tokens_version,
+                                    to_version=ack_version,
+                                    discarded=len(req.tokens),
+                                    at="ack")
+                            del req.tokens[:]
+                            raise _VersionRetry()
+                        if req.ctx is not None:
+                            _rtrace.event(req.ctx, "memberRecv",
+                                          parent=hop_span,
+                                          member=msg.get("member"),
+                                          pid=msg.get("pid"),
+                                          version=msg.get("version"))
+                    elif ev == "tok":
+                        if req.fail_t is not None:
+                            # kill-to-first-replayed-token: the fleet
+                            # recovery number
+                            _RECOVERY_SECONDS.observe(
+                                time.perf_counter() - req.fail_t)
+                            req.fail_t = None
+                        req.tokens.append(int(msg["t"]))
+                        req.tokens_version = m.version
+                    elif ev == "done":
+                        with self._lock:
+                            fenced = m.state == "dead"
+                        if fenced:
+                            # the member was declared dead while this
+                            # reply was in flight (partition healed):
+                            # a dead member's word is never trusted —
+                            # its streamed tokens go with it, and the
+                            # request re-drives on a live peer (greedy
+                            # determinism makes the re-drive exact)
+                            del req.tokens[hop_start:]
+                            _FENCED.inc()
+                            if req.ctx is not None:
+                                _rtrace.event(req.ctx, "fencedReply",
+                                              member=m.id)
+                            raise _MemberError(
+                                "stale reply from dead member %s "
+                                "fenced" % m.id)
+                        # the done frame is authoritative for this
+                        # hop's tokens (the stream includes an EOS the
+                        # scheduler then strips; done does not)
+                        req.tokens[hop_start:] = [
+                            int(t) for t in msg.get("tokens", ())]
+                        req.version = msg.get("version", m.version)
+                        req.member = m.id
+                        req.tokens_version = req.version
+                        with self._lock:
+                            m.served += 1
+                            m.version = req.version
+                        if m.breaker is not None:
+                            m.breaker.record_success()
+                        self._resolve_ok(req)
+                        return True
+                    elif ev == "err":
+                        kind = msg.get("kind")
+                        if kind == "deadline":
+                            # the worker's deadline check fired: same
+                            # condition, same exception type and
+                            # counter as a router-side expiry
+                            _sres.DEADLINE_EXCEEDED.inc()
+                            if req.ctx is not None:
+                                _rtrace.event(req.ctx,
+                                              "deadlineExpired",
+                                              where="member",
+                                              member=m.id)
+                            self._resolve_err(
+                                req, ServingDeadlineError(
+                                    msg.get("error", "")))
+                            return True
+                        if kind == "client":
+                            # the request's fault (bucket/length):
+                            # never charges the member, never replays
+                            self._resolve_err(
+                                req, ValueError(msg.get("error", "")))
+                            return True
+                        raise _MemberError(
+                            "member %s failed the request: %s"
+                            % (m.id, msg.get("error", "")))
+            finally:
+                with self._lock:
+                    m.conns.discard(conn)
+                conn.close()
+        finally:
+            self._release_member(m)
+
+    # -- rolling deploy ---------------------------------------------------
+    def _drain_member(self, m, timeout):
+        with self._lock:
+            if m.state == "dead":
+                return False
+            m.state = "draining"
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if m.state == "dead":
+                    return False
+                if m.inflight == 0:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def _member_call(self, m, msg, timeout=60.0):
+        try:
+            return _wire.call_once(m.addr, msg, timeout=timeout,
+                                   retries=1)
+        except (ConnectionError, OSError, _wire.WireError) as exc:
+            return {"ok": False, "error": repr(exc)[:200]}
+
+    def _rollback_members(self, mids, drain_timeout):
+        _ROLLBACKS.inc()
+        restored = []
+        for mid in reversed(mids):
+            with self._lock:
+                m = self._members.get(mid)
+            if m is None or m.state == "dead":
+                continue
+            self._drain_member(m, drain_timeout)
+            rep = self._member_call(m, {"cmd": "rollback"})
+            with self._lock:
+                if m.state != "dead":
+                    m.state = "live"
+                    if rep.get("ok"):
+                        m.version = rep.get("version", m.version)
+            if rep.get("ok"):
+                restored.append(mid)
+                if m.breaker is not None and not m.breaker.retired:
+                    # the rollback restored the version that was
+                    # serving fine — failures charged to the bad push
+                    # must not keep the healed member benched for a
+                    # full cooldown
+                    m.breaker.record_success()
+            _log.structured("fleet_member_rolled_back", member=mid,
+                            ok=bool(rep.get("ok")))
+        return restored
+
+    def rolling_deploy(self, params_path=None, tag=None,
+                       model_dir=None, canary_requests=6,
+                       watch_failures=2, watch_timeout=30.0,
+                       drain_timeout=30.0, swap_timeout=120.0):
+        """Roll a weights push through the fleet, one member at a
+        time: drain -> swap (the worker's PR-7/PR-9 gates apply) ->
+        canary-scope ``canary_fraction`` of live traffic to it ->
+        watch. ``watch_failures`` member-level failures during the
+        watch (clients see none — canary failures replay onto stable
+        members) roll the WHOLE fleet back to the prior version and
+        abort. Returns a result dict; ``rolled_back`` tells the story.
+
+        ``params_path`` (an ``.npz`` of {name: array}) feeds
+        generation-scheduler workers; ``model_dir`` feeds stateless
+        engine workers (``ServingEngine.swap_weights``)."""
+        if not self._deploy_lock.acquire(blocking=False):
+            raise RuntimeError("a rolling deploy is already running")
+        try:
+            with self._lock:
+                order = sorted(m.id for m in self._members.values()
+                               if m.state == "live")
+            if not order:
+                return {"ok": False, "reason": "no live members",
+                        "rolled_back": False, "swapped": []}
+            swapped = []
+            swap_msg = {"cmd": "swap", "tag": tag}
+            if params_path is not None:
+                swap_msg["params_path"] = str(params_path)
+            if model_dir is not None:
+                swap_msg["model_dir"] = str(model_dir)
+            _log.structured("fleet_deploy_start", tag=tag,
+                            members=order)
+            for mid in order:
+                with self._lock:
+                    m = self._members.get(mid)
+                if m is None or m.state == "dead":
+                    continue  # died mid-deploy: the survivors roll on
+                if not self._drain_member(m, drain_timeout):
+                    self._rollback_members(swapped, drain_timeout)
+                    _DEPLOYS.labels(outcome="rolled_back").inc()
+                    return {"ok": False, "rolled_back": True,
+                            "reason": "drain timeout on %s" % mid,
+                            "failed_member": mid, "swapped": swapped}
+                rep = self._member_call(m, swap_msg,
+                                        timeout=swap_timeout)
+                if not rep.get("ok"):
+                    with self._lock:
+                        if m.state == "draining":
+                            m.state = "live"
+                    self._rollback_members(swapped, drain_timeout)
+                    _DEPLOYS.labels(outcome="rolled_back").inc()
+                    return {"ok": False, "rolled_back": True,
+                            "reason": "swap rejected on %s: %s"
+                            % (mid, rep.get("error")),
+                            "failed_member": mid, "swapped": swapped}
+                with self._lock:
+                    m.version = rep.get("version", tag)
+                    m.served = 0
+                    m.failures = 0
+                    m.state = "canary"
+                    self._canary = mid
+                swapped.append(mid)
+                ok = self._watch_canary(m, canary_requests,
+                                        watch_failures, watch_timeout)
+                with self._lock:
+                    self._canary = None
+                    if m.state == "canary":
+                        m.state = "live"
+                if not ok:
+                    self._rollback_members(swapped, drain_timeout)
+                    _DEPLOYS.labels(outcome="rolled_back").inc()
+                    _log.structured("fleet_deploy_rolled_back",
+                                    tag=tag, failed_member=mid)
+                    _flight.RECORDER.trigger_async(
+                        "fleet_deploy_rollback", tag=str(tag),
+                        member=mid)
+                    return {"ok": False, "rolled_back": True,
+                            "reason": "canary watch failed on %s"
+                            % mid,
+                            "failed_member": mid, "swapped": swapped}
+            _DEPLOYS.labels(outcome="committed").inc()
+            _log.structured("fleet_deploy_committed", tag=tag,
+                            members=swapped)
+            return {"ok": True, "rolled_back": False, "version": tag,
+                    "swapped": swapped}
+        finally:
+            self._deploy_lock.release()
+
+    def _watch_canary(self, m, canary_requests, watch_failures,
+                      watch_timeout):
+        """Watch the freshly-swapped member take its canary share:
+        fail on ``watch_failures`` member-level failures (or its
+        death), pass once ``canary_requests`` completions land — or
+        at the watch timeout with zero failures (a quiet fleet can't
+        prove more than 'nothing broke')."""
+        deadline = time.monotonic() + watch_timeout
+        while True:
+            with self._lock:
+                dead = m.state == "dead"
+                served, failures = m.served, m.failures
+            if dead or failures >= max(1, int(watch_failures)):
+                return False
+            if served >= int(canary_requests):
+                return True
+            if time.monotonic() >= deadline:
+                return failures == 0
+            time.sleep(0.02)
+
+    # -- shutdown ---------------------------------------------------------
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            conns = [c for m in self._members.values()
+                     for c in m.conns]
+            for m in self._members.values():
+                m.conns.clear()
+                if m.breaker is not None:
+                    m.breaker.retired = True
+        self._monitor_stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=2.0)
+        self._server.close()
+        for conn in conns:
+            conn.close()
+        # router-namespace gauge sweep: every member-labelled child
+        # ("f<rid>:*") across every family, plus the router's own
+        # gauges — redeploy cycles must not accumulate stale labels
+        prefix = "f%d:" % self._rid
+        _metrics.REGISTRY.remove_labeled("replica", prefix=prefix)
+        _metrics.REGISTRY.remove_labeled("member", prefix=prefix)
+        _metrics.REGISTRY.remove_labeled("router",
+                                         value="f%d" % self._rid)
+        from ..observability import health as _health
+        _health.unregister_health(self._health_name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _router_health(ref):
+    def snapshot():
+        router = ref()
+        if router is None:
+            return None
+        with router._lock:
+            members = {
+                m.id: {"state": m.state, "version": m.version,
+                       "inflight": m.inflight,
+                       "breaker": None if m.breaker is None
+                       else m.breaker.state}
+                for m in router._members.values()}
+            live = [m for m in router._members.values()
+                    if m.state in ("live", "draining", "canary")]
+            healthy_members = [
+                m for m in live
+                if m.breaker is None or m.breaker.state != "open"]
+            return {"healthy": not router._closed and
+                    len(healthy_members) >= router.members_min,
+                    "generation": router._generation,
+                    "live": len(live), "members": members}
+    return snapshot
+
+
+class EngineWorker:
+    """One fleet member: serves a local backend over the JSON-line
+    wire and keeps its membership lease with the router.
+
+    ``backend`` is a :class:`GenerationScheduler` (``generate``
+    requests, token streaming, ``.npz`` weight swaps with host-side
+    rollback snapshots) or a :class:`ServingEngine` (stateless
+    ``run`` requests, ``model_dir`` swaps through the PR-7 gates).
+    With ``router_addr`` set, the worker registers and heartbeats
+    every ``heartbeat_ms`` (default: the ``fleet_heartbeat_ms`` flag)
+    on a daemon thread — a ``genmismatch`` reply re-registers, a
+    connection error is absorbed (the router may be restarting).
+    ``fail_after_swap_tag`` is the chaos hook for deploy tests: a
+    swap landing that tag arms a persistent ``generation_step_fail``
+    (the stand-in for a broken weights push), disarmed again by the
+    rollback that restores the prior version.
+    """
+
+    def __init__(self, backend, host="127.0.0.1", port=0,
+                 member_id=None, router_addr=None, heartbeat_ms=None,
+                 version="v0", fail_after_swap_tag=None,
+                 autostart=True):
+        self.backend = backend
+        self._kind = ("generation" if hasattr(backend, "sessions")
+                      else "engine")
+        if self._kind == "engine":
+            # the pre-deploy artifact dir IS the first swap's
+            # rollback target — without it a failed first push has
+            # nothing to roll back to
+            self._cur_dir = getattr(backend, "model_dir", None)
+        self.member_id = member_id or "w-%d" % os.getpid()
+        self.router_addr = (tuple(router_addr)
+                            if router_addr is not None else None)
+        if heartbeat_ms is None:
+            heartbeat_ms = _config.get_flag("fleet_heartbeat_ms")
+        self.heartbeat = float(heartbeat_ms) / 1e3
+        self.version = str(version)
+        self.fail_after_swap_tag = fail_after_swap_tag
+        self._prev = None          # (version, params/model_dir) snapshot
+        self._armed_bad = False
+        self._swap_lock = threading.Lock()
+        self.generation = 0
+        self._host, self._port = host, port
+        self._server = None
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        self._stop_evt = threading.Event()
+        if autostart:
+            self.start()
+
+    @property
+    def addr(self):
+        return self._server.addr
+
+    def start(self):
+        if self._server is not None:
+            return self
+        self._server = _wire.LineServer(
+            self._handle, host=self._host, port=self._port,
+            timeout=None, name="fleet-worker-%s" % self.member_id)
+        if self.router_addr is not None:
+            try:
+                self._register()
+            except BaseException:
+                # a refused/unreachable registration must not leak
+                # the accept thread + bound socket out of a failed
+                # constructor (autostart callers never get a handle
+                # to close)
+                self._server.close()
+                self._server = None
+                raise
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, daemon=True,
+                name="fleet-hb-%s" % self.member_id)
+            self._hb_thread.start()
+        return self
+
+    # -- membership -------------------------------------------------------
+    def _register(self):
+        rep = _wire.call_once(
+            self.router_addr,
+            {"cmd": "reg", "member": self.member_id,
+             "addr": list(self.addr), "version": self.version},
+            timeout=5.0, retries=5)
+        if not rep.get("ok"):
+            raise RuntimeError("fleet registration refused: %r" % rep)
+        self.generation = int(rep["generation"])
+        return self.generation
+
+    def _hb_loop(self):
+        beats = 0
+        while not self._hb_stop.wait(self.heartbeat):
+            beats += 1
+            if _faults.should_fire("fleet_network_partition",
+                                   self.member_id):
+                continue  # injected partition: the beat never leaves
+            try:
+                rep = _wire.call_once(
+                    self.router_addr,
+                    {"cmd": "hb", "member": self.member_id,
+                     "generation": self.generation},
+                    timeout=2.0, retries=1)
+            except (ConnectionError, OSError, _wire.WireError):
+                continue  # router restarting/unreachable: keep beating
+            if rep.get("ok"):
+                continue
+            if rep.get("genmismatch") is not None:
+                # the fleet resized (or a restarted router forgot us):
+                # re-register at the current generation
+                try:
+                    self._register()
+                except (RuntimeError, ConnectionError, OSError):
+                    pass
+
+    # -- the wire ---------------------------------------------------------
+    def _handle(self, conn, msg):
+        cmd = msg.get("cmd")
+        if cmd == "generate":
+            return self._handle_generate(conn, msg)
+        if cmd == "run":
+            return self._handle_run(conn, msg)
+        if cmd == "swap":
+            conn.send(self._handle_swap(msg))
+        elif cmd == "rollback":
+            conn.send(self._handle_rollback())
+        elif cmd == "health":
+            conn.send({"ok": True, "member": self.member_id,
+                       "version": self.version, "pid": os.getpid()})
+        elif cmd == "stop":
+            conn.send({"ok": True})
+            self._stop_evt.set()
+        else:
+            conn.send({"ok": False, "error": "unknown cmd %r" % cmd})
+
+    def _handle_generate(self, conn, msg):
+        # the slow-member site fires before ANY reply leaves — a
+        # wedged member is silent, not chatty
+        _faults.fire_point("fleet_slow_member", index=self.member_id)
+        if self._kind != "generation":
+            conn.send({"ev": "err", "kind": "client",
+                       "error": "this member serves a stateless "
+                       "engine — use cmd=run"})
+            return
+        trace_id = msg.get("trace_id")
+        # adopt the ROUTER's trace id (wire propagation): when this
+        # process has tracing armed, its own store grows the same
+        # tree; either way the ack below carries the memberRecv info
+        # back for the router's tree
+        ctx = _rtrace.adopt(trace_id, "fleet.memberServe",
+                            member=self.member_id) \
+            if trace_id else None
+        if ctx is not None:
+            _rtrace.event(ctx, "memberRecv", member=self.member_id,
+                          pid=os.getpid(), version=self.version)
+        eos_id = msg.get("eos_id")
+        if eos_id is None:
+            eos_id = int(self.backend.sessions[0].spec.eos_id)
+        conn.send({"ev": "ack", "member": self.member_id,
+                   "pid": os.getpid(), "version": self.version,
+                   "eos_id": int(eos_id)})
+        tokq = queue.Queue()
+        version_start = self.version
+        try:
+            with _rtrace.activate(ctx):
+                fut = self.backend.submit(
+                    msg["prompt"], max_new_tokens=msg.get("max_new"),
+                    eos_id=msg.get("eos_id"),
+                    deadline_ms=msg.get("deadline_ms"),
+                    on_token=tokq.put)
+        except ServingDeadlineError as exc:
+            conn.send({"ev": "err", "kind": "deadline",
+                       "error": repr(exc)[:300]})
+            return
+        except ValueError as exc:
+            conn.send({"ev": "err", "kind": "client",
+                       "error": repr(exc)[:300]})
+            return
+        except Exception as exc:
+            conn.send({"ev": "err", "kind": "server",
+                       "error": repr(exc)[:300]})
+            return
+        streamed = 0
+        try:
+            while True:
+                try:
+                    t = tokq.get(timeout=0.05)
+                except queue.Empty:
+                    if fut.done() and tokq.empty():
+                        break
+                    continue
+                streamed += 1
+                # chaos: SIGKILL this member after streaming token N —
+                # the deterministic mid-generation process death
+                _faults.fire_point("fleet_member_kill", index=streamed)
+                conn.send({"ev": "tok", "t": int(t)})
+        except OSError:
+            return  # client (router) went away mid-stream
+        try:
+            tokens = [int(t) for t in fut.result(timeout=0)]
+        except Exception as exc:
+            # "deadline" keeps its type across the wire (the router
+            # re-raises ServingDeadlineError — the contract every
+            # serving caller catches); "client" is the request's own
+            # fault (never charged, never replayed)
+            if isinstance(exc, ServingDeadlineError):
+                kind = "deadline"
+            elif isinstance(exc, ValueError):
+                kind = "client"
+            else:
+                kind = "server"
+            try:
+                conn.send({"ev": "err", "kind": kind,
+                           "error": repr(exc)[:300]})
+            except OSError:
+                pass
+            return
+        try:
+            conn.send({"ev": "done", "tokens": tokens,
+                       "member": self.member_id,
+                       "version": self.version,
+                       "version_start": version_start,
+                       "streamed": streamed})
+        except OSError:
+            pass
+
+    def _handle_run(self, conn, msg):
+        _faults.fire_point("fleet_slow_member", index=self.member_id)
+        if self._kind != "engine":
+            conn.send({"ev": "err", "kind": "client",
+                       "error": "this member serves a generation "
+                       "scheduler — use cmd=generate"})
+            return
+        try:
+            feed = {name: np.asarray(spec["data"],
+                                     dtype=spec["dtype"])
+                    for name, spec in msg["feed"].items()}
+            outs = self.backend.run(
+                feed, deadline_ms=msg.get("deadline_ms"))
+            conn.send({"ev": "done", "member": self.member_id,
+                       "version": self.version,
+                       "outputs": [{"data": np.asarray(o).tolist(),
+                                    "dtype": str(np.asarray(o).dtype)}
+                                   for o in outs]})
+        except ValueError as exc:
+            conn.send({"ev": "err", "kind": "client",
+                       "error": repr(exc)[:300]})
+        except Exception as exc:
+            conn.send({"ev": "err", "kind": "server",
+                       "error": repr(exc)[:300]})
+
+    # -- deploys ----------------------------------------------------------
+    def _handle_swap(self, msg):
+        tag = str(msg.get("tag"))
+        with self._swap_lock:
+            try:
+                if self._kind == "generation":
+                    # host-side rollback snapshot of exactly the
+                    # params the push names, taken BEFORE the swap
+                    params = {k: np.asarray(v) for k, v in
+                              np.load(msg["params_path"]).items()}
+                    scope = self.backend.sessions[0].scope
+                    snapshot = {}
+                    for name in params:
+                        cur = scope.find_var(name)
+                        if cur is not None:
+                            snapshot[name] = np.array(cur, copy=True)
+                    self.backend.swap_weights(params)
+                else:
+                    # engine members roll back by re-swapping the
+                    # prior artifact dir (PR-7 gates both ways)
+                    snapshot = getattr(self, "_cur_dir", None)
+                    self.backend.swap_weights(msg["model_dir"])
+                    self._cur_dir = msg["model_dir"]
+            except Exception as exc:
+                return {"ok": False, "error": repr(exc)[:300],
+                        "version": self.version}
+            self._prev = (self.version, snapshot)
+            prev_tag = self.version
+            self.version = tag
+            if self._armed_bad:
+                _faults.disarm("generation_step_fail")
+                self._armed_bad = False
+            if self.fail_after_swap_tag is not None and \
+                    tag == str(self.fail_after_swap_tag):
+                # deploy-chaos hook: this push is "broken" — every
+                # decode step on it fails until a rollback restores
+                # the prior version
+                _faults.arm("generation_step_fail", times=None)
+                self._armed_bad = True
+            _log.structured("fleet_worker_swapped",
+                            member=self.member_id, version=tag,
+                            prev=prev_tag)
+            return {"ok": True, "version": self.version}
+
+    def _handle_rollback(self):
+        with self._swap_lock:
+            if self._prev is None:
+                return {"ok": False, "error": "nothing to roll back",
+                        "version": self.version}
+            prev_tag, snapshot = self._prev
+            if snapshot is None:
+                return {"ok": False, "version": self.version,
+                        "error": "no prior weights snapshot"}
+            try:
+                self.backend.swap_weights(snapshot)
+            except Exception as exc:
+                return {"ok": False, "error": repr(exc)[:300],
+                        "version": self.version}
+            self.version = prev_tag
+            self._prev = None
+            if self._armed_bad:
+                _faults.disarm("generation_step_fail")
+                self._armed_bad = False
+            _log.structured("fleet_worker_rolled_back",
+                            member=self.member_id, version=prev_tag)
+            return {"ok": True, "version": self.version}
+
+    # -- lifecycle --------------------------------------------------------
+    def serve_forever(self):
+        """Block until a ``stop`` command (or :meth:`close`) — the
+        child-process entry point."""
+        self._stop_evt.wait()
+        self.close()
+
+    def close(self):
+        self._stop_evt.set()
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+            self._hb_thread = None
+        if self.router_addr is not None:
+            try:
+                _wire.call_once(self.router_addr,
+                                {"cmd": "unreg",
+                                 "member": self.member_id},
+                                timeout=1.0, retries=1)
+            except (ConnectionError, OSError, _wire.WireError):
+                pass
+        if self._armed_bad:
+            _faults.disarm("generation_step_fail")
+            self._armed_bad = False
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()

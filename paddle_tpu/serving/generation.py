@@ -836,10 +836,11 @@ class _GenRequest:
     __slots__ = ("prompt", "max_new", "explicit_budget", "eos_id",
                  "future", "deadline", "t_submit", "tokens", "slot",
                  "session_index", "t_last", "t_queued", "replays",
-                 "charged", "failed_on", "last_exc", "ctx")
+                 "charged", "failed_on", "last_exc", "ctx",
+                 "on_token")
 
     def __init__(self, prompt, max_new, explicit_budget, eos_id,
-                 deadline):
+                 deadline, on_token=None):
         self.prompt = prompt
         self.max_new = max_new
         # True when the CALLER asked for max_new tokens (placement
@@ -883,6 +884,25 @@ class _GenRequest:
         # failover hop keeps its trace id across sessions for free —
         # the one-trace-per-request contract.
         self.ctx = None
+        # optional per-token observer (the fleet tier streams tokens
+        # over the wire as they decode, so a killed process's journal
+        # survives on the router). Called on the dispatcher thread
+        # with each NEWLY generated token — including an EOS the
+        # resolution then strips (the Future's result stays
+        # authoritative) and the token a replay re-admission owed;
+        # never re-called for journal tokens a replay re-prefills.
+        # Must not block; an observer exception is the caller's bug
+        # but must not kill the dispatcher.
+        self.on_token = on_token
+
+    def notify_token(self, token):
+        if self.on_token is not None:
+            try:
+                self.on_token(token)
+            except Exception:  # noqa: BLE001 — dispatcher must live
+                _log.logger().warning(
+                    "generation on_token observer failed",
+                    exc_info=True)
 
     def history(self):
         """The replay journal: prompt plus every token generated so
@@ -1039,7 +1059,7 @@ class GenerationScheduler:
 
     # -- admission -------------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_ms=None, timeout=None):
+               deadline_ms=None, timeout=None, on_token=None):
         """Enqueue one prompt; returns a Future of its generated ids.
 
         ``max_new_tokens`` is capped by the slot capacity left after
@@ -1047,7 +1067,10 @@ class GenerationScheduler:
         (default: the scheduler's ``deadline_ms``, itself defaulting
         to the ``serving_deadline_ms`` flag; 0/None = none) bounds the
         WHOLE generation. ``timeout``: seconds to wait on a full
-        queue before :class:`ServingOverloadError`."""
+        queue before :class:`ServingOverloadError`. ``on_token``:
+        optional observer called with each newly generated token on
+        the dispatcher thread (the fleet tier's streaming hook —
+        default None costs one attribute check per token)."""
         if self._closed:
             raise RuntimeError("scheduler is closed")
         prompt = np.asarray(prompt, np.int64).reshape(-1)
@@ -1095,7 +1118,8 @@ class GenerationScheduler:
                     "the %.1f ms deadline budget"
                     % (projected * 1e3, budget * 1e3))
             deadline = time.monotonic() + budget
-        item = _GenRequest(prompt, max_new, explicit, eos_id, deadline)
+        item = _GenRequest(prompt, max_new, explicit, eos_id, deadline,
+                           on_token=on_token)
         # minted at the front door (one attribute read when off),
         # carried on the item/journal through every queue, session,
         # and replay hop
@@ -1428,6 +1452,7 @@ class GenerationScheduler:
         item.slot = slot
         item.session_index = si
         item.tokens.append(first)
+        item.notify_token(first)
         self._active[(si, slot)] = item
         self._update_occupancy()
         self._finish_if_done(item)  # EOS/budget can end it at token 1
@@ -1726,6 +1751,7 @@ class GenerationScheduler:
                     continue
                 advanced += 1
                 it.tokens.append(toks[slot])
+                it.notify_token(toks[slot])
                 _INTER_TOKEN_SECONDS.observe(now_pc - it.t_last)
                 it.t_last = now_pc
                 if it.ctx is not None:

@@ -1,0 +1,925 @@
+"""Serving-fleet tests: wire protocol, membership/fencing, routed
+placement, cross-process token-replay failover, rolling deploys.
+
+In-process units drive the router against FAKE members — tiny
+LineServers speaking the worker protocol with a deterministic
+"greedy LM" (next token is a pure function of the history), so
+journal re-drive semantics are proven without jax in the loop. The
+real-model path runs one in-process EngineWorker end to end. The
+subprocess chaos acceptance (SIGKILL one of 3 engine workers
+mid-generation; rolling deploy under concurrent traffic with an
+injected bad push) lives behind the ``slow`` marker, out of tier-1.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as ptpu
+from paddle_tpu.observability import metrics, request_trace
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving import wire
+from paddle_tpu.serving.fleet import EngineWorker, FleetRouter
+from paddle_tpu.serving.resilience import (ServingDeadlineError,
+                                           ServingUnavailableError)
+
+import fleet_worker_child as child
+
+pytestmark = pytest.mark.fleet
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def counter(name):
+    for s in metrics.REGISTRY.dump().get(name, {}).get("samples", ()):
+        return s["value"]
+    return 0.0
+
+
+def fake_next(hist):
+    """The fake members' 'greedy LM': a pure function of the history,
+    never the EOS id — re-driving a journal anywhere reproduces the
+    fault-free continuation exactly, like real greedy decode."""
+    return (sum(hist) * 7 + 3) % 60 + 2
+
+
+def fake_oracle(prompt, n):
+    hist = list(prompt)
+    out = []
+    for _ in range(n):
+        t = fake_next(hist)
+        hist.append(t)
+        out.append(t)
+    return out
+
+
+class FakeMember:
+    """A LineServer speaking the EngineWorker protocol without jax:
+    configurable weights version (the version SHIFTS the token
+    function, like real weights would), per-request die-after-K
+    streaming, artificial latency, and fail-every-request mode."""
+
+    def __init__(self, version="v0", die_after=None, delay=0.0,
+                 fail=False, shift=None):
+        self.version = version
+        self.die_after = die_after
+        self.fail = fail
+        self.delay = delay
+        self.shift = (0 if shift is None
+                      else shift)  # version-dependent token offset
+        self.requests = []  # prompts received, in arrival order
+        self.server = wire.LineServer(self._handle,
+                                      name="fake-member")
+
+    @property
+    def addr(self):
+        return self.server.addr
+
+    def close(self):
+        self.server.close()
+
+    def register(self, router, mid, version=None):
+        rep = wire.call_once(
+            router.addr, {"cmd": "reg", "member": mid,
+                          "addr": list(self.addr),
+                          "version": version or self.version})
+        assert rep["ok"], rep
+        return rep["generation"]
+
+    def _handle(self, conn, msg):
+        if msg.get("cmd") != "generate":
+            conn.send({"ok": False, "error": "fake member"})
+            return
+        self.requests.append(list(msg["prompt"]))
+        if self.delay:
+            time.sleep(self.delay)
+        if self.fail:
+            conn.send({"ev": "err", "kind": "server",
+                       "error": "injected member failure"})
+            return
+        conn.send({"ev": "ack", "member": "fake", "pid": os.getpid(),
+                   "version": self.version, "eos_id": 1})
+        hist = list(msg["prompt"])
+        out = []
+        n = msg.get("max_new") or 4
+        for i in range(n):
+            t = fake_next(hist) + self.shift
+            hist.append(t)
+            out.append(t)
+            conn.send({"ev": "tok", "t": t})
+            if self.die_after is not None and i + 1 == self.die_after:
+                return False  # close the conn: death mid-stream
+        conn.send({"ev": "done", "tokens": out,
+                   "version": self.version,
+                   "version_start": self.version})
+
+
+def make_router(**kw):
+    kw.setdefault("heartbeat_timeout_ms", 0)  # manual membership
+    kw.setdefault("replay_attempts", 3)
+    return FleetRouter(**kw)
+
+
+class TestWire:
+    def test_roundtrip_and_length_cap(self, monkeypatch):
+        def handler(conn, msg):
+            conn.send({"echo": msg["x"]})
+        srv = wire.LineServer(handler)
+        try:
+            rep = wire.call_once(srv.addr, {"x": [1, 2, 3]})
+            assert rep == {"echo": [1, 2, 3]}
+        finally:
+            srv.close()
+        # an over-long frame is refused at the SENDER
+        with pytest.raises(wire.WireError):
+            wire.send_msg(socket.socket(), {"x": "a" * wire.MAX_LINE})
+        # and a peer streaming past the cap errors the READER instead
+        # of growing the buffer without bound (cap shrunk so the test
+        # doesn't push 8 MiB through a socketpair)
+        monkeypatch.setattr(wire, "MAX_LINE", 1024)
+        a, b = socket.socketpair()
+        try:
+            conn = wire.LineConn(a, timeout=5)
+            b.sendall(b"x" * 2048 + b"\n")
+            with pytest.raises(wire.WireError):
+                conn.recv()
+            # non-JSON within the cap is a WireError too
+            a2, b2 = socket.socketpair()
+            conn2 = wire.LineConn(a2, timeout=5)
+            b2.sendall(b"not json\n")
+            with pytest.raises(wire.WireError):
+                conn2.recv()
+            conn2.close()
+            b2.close()
+        finally:
+            conn.close()
+            b.close()
+
+    def test_retry_delay_jitter_bounds(self):
+        for attempt in range(6):
+            for _ in range(50):
+                d = wire.retry_delay(attempt, backoff=0.05, cap=2.0)
+                lo = min(2.0, 0.05 * 2 ** attempt)
+                assert lo / 2 <= d <= lo
+
+    def test_server_close_unblocks_blocked_client(self):
+        """The teardown discipline (MasterServer.stop satellite, wire
+        tier): a client blocked in recv unblocks when the server
+        closes — promptly, not after its full socket timeout."""
+        srv = wire.LineServer(lambda conn, msg: None)
+        c = wire.LineConn.connect(srv.addr, timeout=10.0)
+        res = {}
+
+        def blocked():
+            try:
+                res["msg"] = c.recv()
+            except Exception as exc:  # noqa: BLE001
+                res["exc"] = exc
+        th = threading.Thread(target=blocked, daemon=True)
+        th.start()
+        time.sleep(0.1)
+        t0 = time.perf_counter()
+        srv.close()
+        th.join(3.0)
+        elapsed = time.perf_counter() - t0
+        assert not th.is_alive()
+        assert elapsed < 1.5, "client sat %.2fs after close" % elapsed
+        c.close()
+
+
+class TestMembership:
+    def test_join_bumps_generation_reregister_does_not(self):
+        router = make_router()
+        fm = FakeMember()
+        try:
+            gen = fm.register(router, "m0")
+            assert gen == 1 and router.members_live() == ["m0"]
+            # same member, same address: a heartbeat-thread
+            # re-register, not a new process — no bump
+            assert fm.register(router, "m0") == 1
+            fm2 = FakeMember()
+            try:
+                assert fm2.register(router, "m1") == 2
+                assert router.members_live() == ["m0", "m1"]
+            finally:
+                fm2.close()
+        finally:
+            router.close()
+            fm.close()
+
+    def test_stale_heartbeat_fenced_but_refreshes(self):
+        router = make_router()
+        fm = FakeMember()
+        fm2 = FakeMember()
+        try:
+            fm.register(router, "m0")
+            fm2.register(router, "m1")  # bumps to gen 2
+            rep = wire.call_once(router.addr,
+                                 {"cmd": "hb", "member": "m0",
+                                  "generation": 1})
+            assert not rep["ok"] and rep["genmismatch"] == 2
+            rep = wire.call_once(router.addr,
+                                 {"cmd": "hb", "member": "m0",
+                                  "generation": 2})
+            assert rep["ok"]
+            # an unknown member's beat says re-register
+            rep = wire.call_once(router.addr,
+                                 {"cmd": "hb", "member": "ghost",
+                                  "generation": 2})
+            assert not rep["ok"] and rep["genmismatch"] == 2
+        finally:
+            router.close()
+            fm.close()
+            fm2.close()
+
+    def test_missed_deadline_drops_member_and_retires_gauges(self):
+        deaths0 = counter("paddle_fleet_member_deaths_total")
+        router = FleetRouter(heartbeat_timeout_ms=250,
+                             breaker_failures=2)
+        fm = FakeMember()
+        try:
+            fm.register(router, "m0")
+            label = "f%d:m0" % router._rid
+            gen0 = router.generation
+            inflight = metrics.REGISTRY.dump()[
+                "paddle_fleet_member_inflight"]["samples"]
+            assert any(s["labels"].get("member") == label
+                       for s in inflight)
+            deadline = time.monotonic() + 5
+            while router.members_live() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert router.members_live() == []
+            assert router.generation == gen0 + 1
+            assert counter("paddle_fleet_member_deaths_total") == \
+                deaths0 + 1
+            # the stale-label sweep: every family labelled on the
+            # dead member is gone (breaker health AND inflight)
+            dump = metrics.REGISTRY.dump()
+            for fam in ("paddle_fleet_member_inflight",
+                        "paddle_serving_replica_healthy"):
+                assert not any(
+                    label in s["labels"].values()
+                    for s in dump.get(fam, {}).get("samples", ())), fam
+        finally:
+            router.close()
+            fm.close()
+
+    def test_router_close_sweeps_member_labels(self):
+        router = make_router(breaker_failures=2)
+        fm = FakeMember()
+        try:
+            fm.register(router, "m0")
+            prefix = "f%d:" % router._rid
+        finally:
+            router.close()
+            fm.close()
+        dump = metrics.REGISTRY.dump()
+        for fam in ("paddle_fleet_member_inflight",
+                    "paddle_serving_replica_healthy",
+                    "paddle_fleet_generation",
+                    "paddle_fleet_members_live"):
+            for s in dump.get(fam, {}).get("samples", ()):
+                assert not any(str(v).startswith(prefix)
+                               for v in s["labels"].values()), fam
+
+    def test_healthz_aggregates_member_health(self):
+        from paddle_tpu.observability import health
+        router = make_router(members_min=2, breaker_failures=1)
+        fm0, fm1 = FakeMember(), FakeMember()
+        try:
+            fm0.register(router, "m0")
+            snap = health.health_snapshot()
+            comp = snap["components"]["fleet%d" % router._rid]
+            assert not comp["healthy"]  # 1 live < members_min=2
+            fm1.register(router, "m1")
+            comp = health.health_snapshot()[
+                "components"]["fleet%d" % router._rid]
+            assert comp["healthy"] and comp["live"] == 2
+            assert comp["members"]["m0"]["breaker"] == "closed"
+        finally:
+            router.close()
+            fm0.close()
+            fm1.close()
+
+
+class TestRouting:
+    def test_least_loaded_placement(self):
+        router = make_router()
+        slow = FakeMember(delay=0.6)
+        idle = FakeMember()
+        try:
+            slow.register(router, "m0")
+            idle.register(router, "m1")
+            f1 = router.submit([5], max_new_tokens=2, meta=True)
+            time.sleep(0.15)  # m0 (lowest index) is now occupied
+            f2 = router.submit([6], max_new_tokens=2, meta=True)
+            assert f2.result(timeout=10)["member"] == "m1"
+            assert f1.result(timeout=10)["member"] == "m0"
+        finally:
+            router.close()
+            slow.close()
+            idle.close()
+
+    def test_journal_redrive_bit_identical(self):
+        """A member dying mid-stream re-drives the journal on a peer:
+        the peer receives prompt ⊕ tokens-so-far and the final output
+        is token-for-token the fault-free continuation."""
+        failovers0 = counter("paddle_fleet_failover_total")
+        router = make_router()
+        dying = FakeMember(die_after=2)
+        peer = FakeMember()
+        try:
+            dying.register(router, "m0")
+            peer.register(router, "m1")
+            out = router.submit([5, 6], max_new_tokens=6,
+                                meta=True).result(timeout=10)
+            want = fake_oracle([5, 6], 6)
+            assert out["tokens"].tolist() == want
+            assert out["member"] == "m1" and out["replays"] == 1
+            assert peer.requests[-1] == [5, 6] + want[:2]
+            assert counter("paddle_fleet_failover_total") == \
+                failovers0 + 1
+            # kill-to-first-replayed-token landed in the histogram
+            sample = metrics.REGISTRY.dump()[
+                "paddle_fleet_recovery_seconds"]["samples"][0]
+            assert sample["count"] >= 1
+        finally:
+            router.close()
+            dying.close()
+            peer.close()
+
+    def test_cross_version_journal_reset(self):
+        """A journal generated under one weights version is never
+        spliced with another: re-driving on a different-version peer
+        discards the partial and restarts from the prompt."""
+        resets0 = counter("paddle_fleet_journal_resets_total")
+        router = make_router()
+        dying = FakeMember(version="v0", die_after=2)
+        peer = FakeMember(version="v1", shift=1)
+        try:
+            dying.register(router, "m0")
+            peer.register(router, "m1")
+            out = router.submit([5, 6], max_new_tokens=6,
+                                meta=True).result(timeout=10)
+            # the full v1 generation, not v0-prefix + v1-suffix
+            hist, want = [5, 6], []
+            for _ in range(6):
+                t = fake_next(hist) + 1
+                hist.append(t)
+                want.append(t)
+            assert out["tokens"].tolist() == want
+            assert out["version"] == "v1"
+            assert peer.requests[-1] == [5, 6]  # journal discarded
+            assert counter("paddle_fleet_journal_resets_total") == \
+                resets0 + 1
+        finally:
+            router.close()
+            dying.close()
+            peer.close()
+
+    def test_breaker_opens_and_trial_readmits(self):
+        router = make_router(breaker_failures=1,
+                             breaker_cooldown_ms=150.0)
+        bad = FakeMember(fail=True)
+        good = FakeMember()
+        try:
+            bad.register(router, "m0")
+            good.register(router, "m1")
+            out = router.submit([7], max_new_tokens=3,
+                                meta=True).result(timeout=10)
+            assert out["member"] == "m1" and out["replays"] == 1
+            with router._lock:
+                breaker = router._members["m0"].breaker
+            assert breaker.state == "open"
+            # while open and cooling, traffic avoids m0 entirely
+            out = router.submit([8], max_new_tokens=3,
+                                meta=True).result(timeout=10)
+            assert out["member"] == "m1" and out["replays"] == 0
+            # heal the member; after the cooldown a trial dispatch
+            # re-admits it (the dispatch IS the probe)
+            bad.fail = False
+            time.sleep(0.2)
+            deadline = time.monotonic() + 5
+            served_by_m0 = False
+            while time.monotonic() < deadline and not served_by_m0:
+                got = router.submit([9], max_new_tokens=2,
+                                    meta=True).result(timeout=10)
+                served_by_m0 = got["member"] == "m0"
+            assert served_by_m0 and breaker.state == "closed"
+        finally:
+            router.close()
+            bad.close()
+            good.close()
+
+    def test_ack_version_fence_beats_stale_router_cache(self):
+        """The router's cached member version can lie (out-of-band
+        swap, a second router deploying): the worker's ACK is
+        authoritative. A journal re-driven onto a member whose ack
+        reveals different weights is reset BEFORE any of that hop's
+        tokens land — no breaker charge, no replay burned, and the
+        response is entirely one version."""
+        resets0 = counter("paddle_fleet_journal_resets_total")
+        router = make_router()
+        dying = FakeMember(version="v0", die_after=2)
+        peer = FakeMember(version="v1", shift=1)
+        try:
+            dying.register(router, "m0")
+            # the cache lies: the peer registered as v0 but its acks
+            # say v1 (it was swapped behind this router's back)
+            peer.register(router, "m1", version="v0")
+            out = router.submit([5, 6], max_new_tokens=6,
+                                meta=True).result(timeout=10)
+            hist, want = [5, 6], []
+            for _ in range(6):
+                t = fake_next(hist) + 1
+                hist.append(t)
+                want.append(t)
+            assert out["tokens"].tolist() == want
+            assert out["version"] == "v1" == out["version_start"]
+            # one replay (the death); the version retry burned none
+            assert out["replays"] == 1
+            # the stale journal DID go out on the first peer hop (the
+            # cache said v0), was reset at ack, and the retry hop
+            # carried the bare prompt
+            assert peer.requests[0][:2] == [5, 6] and \
+                len(peer.requests[0]) == 4
+            assert peer.requests[-1] == [5, 6]
+            assert counter("paddle_fleet_journal_resets_total") == \
+                resets0 + 1
+        finally:
+            router.close()
+            dying.close()
+            peer.close()
+
+    def test_hang_past_call_timeout_opens_instantly(self):
+        """A member silent past the per-call timeout is a hang: the
+        breaker opens on the single event (the PR-5 rule — a wedged
+        process is not worth N more victims) and the request fails
+        over. ``fleet_slow_member`` armed in a worker process
+        produces exactly this shape."""
+        router = make_router(breaker_failures=5, call_timeout=0.3,
+                             breaker_cooldown_ms=60000.0)
+        wedged = FakeMember(delay=1.2)
+        peer = FakeMember()
+        try:
+            wedged.register(router, "m0")
+            peer.register(router, "m1")
+            out = router.submit([5], max_new_tokens=3,
+                                meta=True).result(timeout=10)
+            assert out["member"] == "m1" and out["replays"] == 1
+            with router._lock:
+                breaker = router._members["m0"].breaker
+            assert breaker.state == "open"  # 1 hang << threshold 5
+        finally:
+            router.close()
+            wedged.close()
+            peer.close()
+
+    def test_poison_request_charges_one_breaker(self):
+        """A request that fails on EVERY member charges at most one
+        breaker across its replays — it cannot black out the fleet
+        (the PR-5/9 discipline, promoted one tier up)."""
+        router = make_router(breaker_failures=1,
+                             breaker_cooldown_ms=60000.0,
+                             replay_attempts=2)
+        bad0, bad1 = FakeMember(fail=True), FakeMember(fail=True)
+        try:
+            bad0.register(router, "m0")
+            bad1.register(router, "m1")
+            with pytest.raises(Exception):
+                router.submit([7], max_new_tokens=3).result(timeout=10)
+            with router._lock:
+                states = [router._members[m].breaker.state
+                          for m in ("m0", "m1")]
+            assert states.count("open") == 1, states
+        finally:
+            router.close()
+            bad0.close()
+            bad1.close()
+
+    def test_fenced_stale_reply(self):
+        """A reply landing after its member was declared dead is
+        fenced — discarded and re-driven on a live peer, never
+        trusted (the generation-fencing discipline, serving tier)."""
+        fenced0 = counter("paddle_fleet_fenced_replies_total")
+        router = make_router()
+        zombie = FakeMember(delay=0.5)
+        peer = FakeMember()
+        try:
+            zombie.register(router, "m0")
+            peer.register(router, "m1")
+            fut = router.submit([5, 6], max_new_tokens=4, meta=True)
+            time.sleep(0.2)  # in flight on m0, reply not yet sent
+            # the partition-heal race: the member is declared dead
+            # while its reply is still in the pipe (white-box: state
+            # flips without the conn sweep that normally accompanies
+            # a drop)
+            with router._lock:
+                router._members["m0"].state = "dead"
+                router._generation += 1
+            out = fut.result(timeout=10)
+            assert out["member"] == "m1"
+            assert out["tokens"].tolist() == fake_oracle([5, 6], 4)
+            assert counter("paddle_fleet_fenced_replies_total") == \
+                fenced0 + 1
+        finally:
+            router.close()
+            zombie.close()
+            peer.close()
+
+    def test_network_partition_fault_site(self):
+        router = make_router()
+        fm0, fm1 = FakeMember(), FakeMember()
+        try:
+            fm0.register(router, "m0")
+            fm1.register(router, "m1")
+            faults.arm("fleet_network_partition", at="m0", times=1)
+            out = router.submit([4], max_new_tokens=3,
+                                meta=True).result(timeout=10)
+            assert out["member"] == "m1" and out["replays"] == 1
+        finally:
+            faults.disarm()
+            router.close()
+            fm0.close()
+            fm1.close()
+
+    def test_client_error_never_charges_or_replays(self):
+        router = make_router(breaker_failures=1)
+
+        def h(conn, msg):
+            conn.send({"ev": "err", "kind": "client",
+                       "error": "prompt exceeds every bucket"})
+        srv = wire.LineServer(h)
+        try:
+            wire.call_once(router.addr,
+                           {"cmd": "reg", "member": "m0",
+                            "addr": list(srv.addr), "version": "v0"})
+            with pytest.raises(ValueError):
+                router.submit([4], max_new_tokens=3).result(timeout=10)
+            with router._lock:
+                assert router._members["m0"].breaker.state == "closed"
+        finally:
+            router.close()
+            srv.close()
+
+    def test_deadline_and_unavailable(self):
+        router = make_router(placement_timeout=0.2)
+        try:
+            with pytest.raises(ServingDeadlineError):
+                router.submit([4], deadline_ms=-1)
+            fut = router.submit([4], max_new_tokens=2)
+            with pytest.raises(ServingUnavailableError):
+                fut.result(timeout=10)  # no members at all
+        finally:
+            router.close()
+
+
+class TestTracePropagation:
+    def test_single_tree_across_kill_and_replay(self, monkeypatch):
+        """One request killed mid-generation reads router -> dead
+        member -> replay-on-peer in a single span tree: two fleetHop
+        spans, the dead hop's and the peer's memberRecv children, and
+        the failoverRequeue edge between them."""
+        ptpu.config.set_flags(request_tracing=True,
+                              trace_sample_rate=1.0)
+        router = make_router()
+        dying = FakeMember(die_after=2)
+        peer = FakeMember()
+        try:
+            dying.register(router, "m0")
+            peer.register(router, "m1")
+            out = router.submit([5, 6], max_new_tokens=5,
+                                meta=True).result(timeout=10)
+            assert out["replays"] == 1
+            tid = request_trace.trace_ids()[-1]
+            events = request_trace.trace_events(tid)
+            names = [e["name"] for e in events]
+            hops = [e for e in events if e["name"] == "fleetHop"]
+            assert len(hops) == 2
+            assert [h["attrs"]["member"] for h in hops] == ["m0", "m1"]
+            assert "failoverRequeue" in names
+            assert "resolve" in names
+            recvs = [e for e in events if e["name"] == "memberRecv"]
+            # both members acked before the death: two memberRecv
+            # children, each parented under its own hop span
+            assert len(recvs) == 2
+            assert {r["parent_id"] for r in recvs} == \
+                {h["span_id"] for h in hops}
+            tree = request_trace.span_tree(tid)
+            assert tree["root"]["name"] == "request"
+        finally:
+            ptpu.config.set_flags(request_tracing=False)
+            request_trace.clear()
+            router.close()
+            dying.close()
+            peer.close()
+
+    def test_adopt_joins_remote_trace(self):
+        ptpu.config.set_flags(request_tracing=True,
+                              trace_sample_rate=1.0)
+        try:
+            ctx = request_trace.adopt("t00000000deadbeef",
+                                      "fleet.memberServe", member="m0")
+            assert ctx is not None
+            request_trace.event(ctx, "memberRecv", member="m0")
+            events = request_trace.trace_events("t00000000deadbeef")
+            assert [e["name"] for e in events] == \
+                ["fleet.memberServe", "memberRecv"]
+        finally:
+            ptpu.config.set_flags(request_tracing=False)
+            request_trace.clear()
+        # off: adopt is inert
+        assert request_trace.adopt("t1", "x") is None
+
+
+class TestMasterStop:
+    def test_graceful_stop_unblocks_blocked_client(self, tmp_path):
+        """MasterServer.stop(graceful=True) satellite: a client
+        blocked in recv on an idle connection unblocks promptly when
+        the master drains and closes (shutdown-before-close on the
+        server side), instead of waiting out its socket timeout."""
+        from paddle_tpu.distributed import MasterClient, MasterServer
+        srv = MasterServer(str(tmp_path / "snap"), timeout_sec=30)
+        try:
+            c = MasterClient(srv.port)
+            assert c.ping()
+            raw = socket.create_connection(("127.0.0.1", srv.port),
+                                           timeout=8.0)
+            res = {}
+
+            def blocked():
+                try:
+                    res["data"] = raw.recv(64)
+                except Exception as exc:  # noqa: BLE001
+                    res["exc"] = exc
+            th = threading.Thread(target=blocked, daemon=True)
+            th.start()
+            time.sleep(0.2)
+            t0 = time.perf_counter()
+            srv.stop(graceful=True)
+            th.join(4.0)
+            elapsed = time.perf_counter() - t0
+            assert not th.is_alive(), \
+                "client still blocked %.1fs after graceful stop" \
+                % elapsed
+            assert elapsed < 3.0, elapsed
+            raw.close()
+        finally:
+            srv.stop()
+
+
+@pytest.mark.generation
+class TestWorkerInProcess:
+    """One real EngineWorker (tiny LM) in-process: serve, swap,
+    rollback, version reporting — the wire end to end without
+    subprocess cost."""
+
+    def test_serve_swap_rollback(self, tmp_path):
+        scope = child.build_scope(seed=7)
+        v1 = child.model_params(scope, 1.01)
+        sched = child.make_scheduler(scope)
+        router = FleetRouter(heartbeat_timeout_ms=900,
+                             replay_attempts=2)
+        worker = EngineWorker(sched, member_id="m0",
+                              router_addr=router.addr,
+                              heartbeat_ms=100)
+        try:
+            router.wait_members(1, timeout=10)
+            prompt = [child.BOS, 5, 9]
+            out = router.submit(prompt, max_new_tokens=8, eos_id=-1,
+                                meta=True).result(timeout=120)
+            want = [int(t) for t in
+                    sched.submit(prompt, max_new_tokens=8,
+                                 eos_id=-1).result(timeout=120)]
+            assert out["tokens"].tolist() == want
+            assert out["version"] == "v0" == out["version_start"]
+
+            np.savez(str(tmp_path / "v1.npz"), **v1)
+            res = router.rolling_deploy(
+                params_path=str(tmp_path / "v1.npz"), tag="v1",
+                canary_requests=1, watch_timeout=10)
+            assert res["ok"] and not res["rolled_back"], res
+            out1 = router.submit(prompt, max_new_tokens=8, eos_id=-1,
+                                 meta=True).result(timeout=120)
+            assert out1["version"] == "v1" == out1["version_start"]
+            assert router.member_versions() == {"m0": "v1"}
+
+            rep = wire.call_once(worker.addr, {"cmd": "rollback"})
+            assert rep["ok"] and rep["version"] == "v0"
+            out2 = router.submit(prompt, max_new_tokens=8, eos_id=-1,
+                                 meta=True).result(timeout=120)
+            assert out2["tokens"].tolist() == want, \
+                "rollback must restore v0 tokens"
+        finally:
+            worker.close()
+            router.close()
+            sched.close()
+
+
+class TestDefaultsOffHotPath:
+    def test_fleet_flags_read_only_at_construction(self, monkeypatch):
+        """Default flags construct no router/sockets/threads, and the
+        fleet flags are consulted only inside the fleet constructors
+        — a routed submit afterwards reads no config at all at the
+        router tier."""
+        calls = []
+        orig = ptpu.config.get_flag
+
+        def counting(name):
+            calls.append(name)
+            return orig(name)
+        monkeypatch.setattr(ptpu.config, "get_flag", counting)
+        router = make_router()
+        fm = FakeMember()
+        try:
+            fm.register(router, "m0")
+            assert [c for c in calls if c.startswith("fleet_")] == \
+                ["fleet_canary_fraction", "fleet_members_min"]
+            calls.clear()
+            out = router.submit([3], max_new_tokens=3,
+                                meta=True).result(timeout=10)
+            assert len(out["tokens"]) == 3
+            assert not [c for c in calls if c.startswith("fleet_")]
+        finally:
+            router.close()
+            fm.close()
+
+    def test_worker_reads_heartbeat_flag_at_construction(
+            self, monkeypatch):
+        calls = []
+        orig = ptpu.config.get_flag
+
+        def counting(name):
+            calls.append(name)
+            return orig(name)
+        monkeypatch.setattr(ptpu.config, "get_flag", counting)
+        # an unstarted worker around a dummy backend: the flag read
+        # happens in the constructor, nowhere else
+        worker = EngineWorker(object(), autostart=False)
+        assert calls.count("fleet_heartbeat_ms") == 1
+        assert worker.heartbeat == orig("fleet_heartbeat_ms") / 1e3
+        router = FleetRouter(heartbeat_timeout_ms=None)
+        try:
+            assert router.heartbeat_timeout == \
+                3.0 * orig("fleet_heartbeat_ms") / 1e3
+            assert calls.count("fleet_heartbeat_ms") == 2
+        finally:
+            router.close()
+
+
+def _spawn_child(router, mid, *extra):
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "fleet_worker_child.py"),
+         "--router", "%s:%d" % router.addr, "--member", mid,
+         "--heartbeat-ms", "150"] + list(extra),
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    line = proc.stdout.readline().strip()
+    assert line.startswith("READY"), line
+    return proc
+
+
+def _stop_children(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+        p.wait()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestFleetChaosSubprocess:
+    def test_sigkill_one_of_three_mid_generation(self):
+        """Chaos acceptance: 3 engine-worker PROCESSES, >= 24
+        concurrent generation requests, SIGKILL of one worker
+        mid-decode — zero client-visible errors and every output
+        token-identical to the fault-free baseline (the journals
+        re-drive on peers)."""
+        prompts = child.chaos_prompts(24)
+        # fault-free oracle: the same weights, in-process
+        scope = child.build_scope(seed=7)
+        sched = child.make_scheduler(scope, slots=4)
+        futs = [sched.submit(p, max_new_tokens=12, eos_id=-1)
+                for p in prompts]
+        baseline = [[int(t) for t in f.result(timeout=300)]
+                    for f in futs]
+        sched.close()
+
+        deaths0 = counter("paddle_fleet_member_deaths_total")
+        router = FleetRouter(heartbeat_timeout_ms=700,
+                             replay_attempts=6, breaker_failures=2,
+                             breaker_cooldown_ms=60000.0)
+        procs = []
+        try:
+            procs.append(_spawn_child(router, "m0",
+                                      "--kill-at-token", "4"))
+            procs.append(_spawn_child(router, "m1"))
+            procs.append(_spawn_child(router, "m2"))
+            router.wait_members(3, timeout=120)
+            futs = [router.submit(p, max_new_tokens=12, eos_id=-1,
+                                  meta=True) for p in prompts]
+            results, errors = [], []
+            for i, f in enumerate(futs):
+                try:
+                    results.append(f.result(timeout=300))
+                except Exception as exc:  # noqa: BLE001
+                    results.append(None)
+                    errors.append("req %d: %r" % (i, exc))
+            assert not errors, errors
+            mism = [i for i, (got, want)
+                    in enumerate(zip(results, baseline))
+                    if got["tokens"].tolist() != want]
+            assert not mism, mism
+            assert procs[0].poll() is not None, \
+                "worker m0 should have SIGKILLed itself"
+            assert any(r["replays"] > 0 for r in results)
+            # membership: the monitor reaps m0 one heartbeat deadline
+            # after the kill (requests finished faster than that)
+            deadline = time.monotonic() + 10
+            while "m0" in router.members_live() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert "m0" not in router.members_live()
+            assert counter("paddle_fleet_member_deaths_total") >= \
+                deaths0 + 1
+        finally:
+            router.close()
+            _stop_children(procs)
+
+    def test_rolling_deploy_under_traffic_and_bad_push_rollback(
+            self, tmp_path):
+        """Rolling deploy across 3 members under concurrent traffic:
+        every response is served by exactly one weights version and
+        the deploy commits; then an injected BAD push fails its
+        canary watch and the whole fleet rolls back — still zero
+        client-visible errors."""
+        scope = child.build_scope(seed=7)
+        np.savez(str(tmp_path / "v1.npz"),
+                 **child.model_params(scope, 1.01))
+        np.savez(str(tmp_path / "bad.npz"),
+                 **child.model_params(scope, 0.99))
+        router = FleetRouter(heartbeat_timeout_ms=900,
+                             replay_attempts=6,
+                             canary_fraction=0.34)
+        procs = []
+        stop = threading.Event()
+        responses, errors = [], []
+
+        def traffic():
+            rs = np.random.RandomState(3)
+            while not stop.is_set():
+                p = [child.BOS] + [int(t) for t in
+                                   rs.randint(2, child.VOCAB, 3)]
+                try:
+                    out = router.submit(
+                        p, max_new_tokens=6, eos_id=-1,
+                        meta=True).result(timeout=120)
+                    responses.append(out)
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(repr(exc))
+        try:
+            for mid in ("m0", "m1", "m2"):
+                procs.append(_spawn_child(
+                    router, mid, "--fail-after-swap", "bad"))
+            router.wait_members(3, timeout=120)
+            threads = [threading.Thread(target=traffic, daemon=True)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(1.0)
+            res = router.rolling_deploy(
+                params_path=str(tmp_path / "v1.npz"), tag="v1",
+                canary_requests=2, watch_timeout=60)
+            assert res["ok"] and not res["rolled_back"], res
+            assert set(router.member_versions().values()) == {"v1"}
+
+            bad = router.rolling_deploy(
+                params_path=str(tmp_path / "bad.npz"), tag="bad",
+                canary_requests=4, watch_failures=2,
+                watch_timeout=60)
+            assert bad["rolled_back"], bad
+            assert set(router.member_versions().values()) == {"v1"}, \
+                "fleet-wide rollback must restore the prior version"
+            time.sleep(0.5)
+            stop.set()
+            for t in threads:
+                t.join(timeout=120)
+            assert not errors, errors[:5]
+            assert responses
+            # THE deploy invariant: a response is served by exactly
+            # one weights version, start to finish
+            mixed = [r for r in responses
+                     if r["version_start"] != r["version"]]
+            assert not mixed, mixed[:5]
+            assert {r["version"] for r in responses} <= {"v0", "v1"}
+            assert any(r["version"] == "v1" for r in responses)
+        finally:
+            stop.set()
+            router.close()
+            _stop_children(procs)

@@ -531,7 +531,8 @@ class TestDefaultOff:
                                          "request_tracing",
                                          "trace_sample_rate",
                                          "telemetry_port",
-                                         "flight_dir"))]
+                                         "flight_dir",
+                                         "fleet_"))]
             workers = [t for t in threading.enumerate()
                        if t.name.startswith("generation-step-")]
             assert not workers
