@@ -1200,6 +1200,110 @@ def bench_fleet(on_accel):
     }]
 
 
+def bench_recsys(on_accel):
+    """Recsys (wide&deep) training with row-sharded DistEmbedding
+    tables (ISSUE 14): real sparse id batches cross the PR-4 packed
+    wire (one H2D per batch), the tables live mod-interleaved across
+    the mesh, and lookup/gradient exchange runs as the two-hop ICI
+    all_to_all inside the jitted step. Emits two tripwire metrics:
+    ``recsys_examples_per_sec`` (end-to-end train throughput) and
+    ``embedding_lookup_rows_per_sec`` (ids resolved through the
+    distributed tables per second — both tables count).
+
+    Defaults-off contract: the embedding flags must arrive False here
+    (the subsystem is constructed only inside this bench's flag
+    window)."""
+    import jax
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers, parallel
+    from paddle_tpu.reader.staging import StagedReader
+    from paddle_tpu.models.wide_deep import wide_deep
+
+    for flag in ("embedding_shard_rows", "embedding_a2a"):
+        if ptpu.config.get_flag(flag):
+            raise RuntimeError("flag %s armed before bench_recsys — "
+                               "defaults must construct none of the "
+                               "subsystem" % flag)
+
+    ndev = len(jax.devices())
+    shards = 1
+    while shards * 2 <= min(ndev, 8):
+        shards *= 2
+    vocab = 200_000 if on_accel else 20_000
+    slots = 26 if on_accel else 8
+    emb_dim = 32 if on_accel else 8
+    batch = 4096 if on_accel else 16 * shards
+    steps = 30 if on_accel else 8
+
+    prev = {k: ptpu.config.get_flag(k) for k in
+            ("embedding_shard_rows", "embedding_a2a", "packed_feeds")}
+    ptpu.config.set_flags(embedding_shard_rows=True, embedding_a2a=True,
+                          packed_feeds=True)
+    try:
+        strat = parallel.DataParallel(n_devices=shards) \
+            if shards > 1 else None
+        main_prog, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main_prog, startup):
+            ids = layers.data("ids", shape=[slots], dtype="int64")
+            dense = layers.data("dense", shape=[8])
+            label = layers.data("label", shape=[1])
+            loss, _, _ = wide_deep(ids, dense, label, vocab, slots,
+                                   emb_dim=emb_dim, hidden=(64, 32),
+                                   is_distributed=True)
+            ptpu.optimizer.Adagrad(0.05).minimize(
+                loss, startup_program=startup)
+        exe = ptpu.Executor(strategy=strat)
+        exe.run(startup)
+
+        rs = np.random.RandomState(7)
+        host_batches = [
+            {"ids": rs.randint(0, vocab, (batch, slots)).astype("int32"),
+             "dense": rs.randn(batch, 8).astype("float32"),
+             "label": rs.randint(0, 2, (batch, 1)).astype("float32")}
+            for _ in range(3)]
+
+        def reader(n):
+            def gen():
+                for i in range(n):
+                    yield dict(host_batches[i % len(host_batches)])
+            return gen
+
+        # warm the packed compile entry outside the timed window
+        sr = StagedReader(reader(1), strategy=strat, program=main_prog)
+        for staged in sr():
+            exe.run(main_prog, feed=staged, fetch_list=[loss])
+        sr.close()
+
+        sr = StagedReader(reader(steps), strategy=strat,
+                          program=main_prog)
+        last = None
+        t0 = time.perf_counter()
+        for staged in sr():
+            last = exe.run(main_prog, feed=staged, fetch_list=[loss],
+                           return_numpy=False)[0]
+        np.asarray(last)  # drain the async chain
+        elapsed = time.perf_counter() - t0
+        sr.close()
+    finally:
+        ptpu.config.set_flags(**prev)
+
+    suffix = "" if on_accel else "_cpu_smoke"
+    ex_per_sec = batch * steps / elapsed
+    # two distributed tables (deep + wide) each resolve batch*slots ids
+    rows_per_sec = 2 * batch * slots * steps / elapsed
+    common = {"unit_note": "%d-shard tables, vocab %d, %d slots"
+              % (shards, vocab, slots), "num_shards": shards,
+              "batch": batch, "steps": steps}
+    return [
+        dict({"metric": "recsys_examples_per_sec" + suffix,
+              "value": round(ex_per_sec, 1),
+              "unit": "examples/sec"}, **common),
+        dict({"metric": "embedding_lookup_rows_per_sec" + suffix,
+              "value": round(rows_per_sec, 1),
+              "unit": "rows/sec"}, **common),
+    ]
+
+
 def bench_elastic_resume():
     """Measure the elastic control plane's recovery latency on this
     host: a registered peer goes silent, the master declares it dead
@@ -1334,7 +1438,9 @@ def main():
             ("tracing_overhead_pct",
              lambda: bench_tracing_overhead(on_accel)),
             ("fleet_p99_under_kill_ms",
-             lambda: bench_fleet(on_accel))]:
+             lambda: bench_fleet(on_accel)),
+            ("recsys_examples_per_sec",
+             lambda: bench_recsys(on_accel))]:
         try:
             out = _isolated(fn)
             for line in (out if isinstance(out, list) else [out]):

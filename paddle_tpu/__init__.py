@@ -37,6 +37,7 @@ from . import inference  # noqa: F401
 from . import serving  # noqa: F401
 from . import resilience  # noqa: F401
 from . import distributed  # noqa: F401  (paddle_elastic_* always-on)
+from . import embeddings  # noqa: F401  (registers lookup_table_dist ops)
 from .data_feeder import DataFeeder  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 from .place import CPUPlace, TPUPlace, CUDAPlace, is_compiled_with_tpu  # noqa: F401

@@ -250,6 +250,26 @@ fleet_canary_fraction: the share of live traffic a freshly-swapped
   member receives during a rolling deploy's canary watch (the rest of
   the fleet keeps serving the stable version). Read only at router
   construction.
+
+embedding_shard_rows: if True, DistEmbedding tables created by
+  ``layers.embedding(..., is_distributed=True)`` are row-sharded over
+  the mesh data axis by ``row_id % num_shards`` (mod-interleaved
+  storage layout, embeddings/sharded.py) — with their optimizer slots
+  sharded alongside — so no device ever holds a full table. False
+  (default): distributed tables stay replicated and the lookup is a
+  plain dense gather; programs without a DistEmbedding never read this
+  flag (the executor gates on the program's table registry, one
+  getattr). Trace-time: keyed into the executor compile cache for
+  DistEmbedding programs.
+
+embedding_a2a: if True (and ``embedding_shard_rows`` is sharding), the
+  lookup and its gradient exchange run as an explicit two-hop
+  ``all_to_all`` inside the jitted step — id buckets to owning shards,
+  rows back; gradients reverse the route and are merged per shard —
+  the pserver request/response cycle as ICI collectives. False
+  (default): the gather goes through the mod layout as a global-view
+  take and GSPMD chooses the collectives. Same numerics either way;
+  same read discipline as embedding_shard_rows.
 """
 
 import jax
@@ -319,6 +339,11 @@ _flags = {
     "fleet_heartbeat_ms": 1000.0,
     "fleet_members_min": 1,
     "fleet_canary_fraction": 0.25,
+    # sharded embedding tables (embeddings/sharded.py; read only when a
+    # program registered a DistEmbedding — defaults construct none of
+    # the subsystem and plain programs never read these)
+    "embedding_shard_rows": False,
+    "embedding_a2a": False,
 }
 
 # Observers called with the flag dict after every set_flags (the

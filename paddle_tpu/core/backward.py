@@ -137,12 +137,40 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
         for n in set(op.input_names()):
             consumers[n] = consumers.get(n, 0) + 1
     sparse_tables = set()
+    dist_tables = set()
     for op in fwd_ops:
         if op.type == "lookup_table" and op.attrs.get("is_sparse"):
             w = op.input("W")
             if w in param_names and consumers.get(w, 0) == 1:
                 sparse_tables.add(w)
+        elif op.type == "lookup_table_dist":
+            # distributed tables are sparse-gradient however many
+            # lookups share them (per-consumer (rows, values) pairs,
+            # concatenated below): the whole point is never
+            # materializing a table-sized cotangent. Only a NON-lookup
+            # consumer (e.g. weight tying into a matmul) forces the
+            # dense vjp path — loudly, because at DistEmbedding scale
+            # that cotangent is the OOM this subsystem exists to avoid.
+            w = op.input("W")
+            if w in param_names:
+                dist_tables.add(w)
+    lookup_consumers = {}
+    for op in fwd_ops:
+        if op.type == "lookup_table_dist":
+            w = op.input("W")
+            lookup_consumers[w] = lookup_consumers.get(w, 0) + 1
+    for w in sorted(dist_tables):
+        if lookup_consumers.get(w, 0) != consumers.get(w, 0):
+            dist_tables.discard(w)
+            import logging
+            logging.getLogger("paddle_tpu").warning(
+                "distributed embedding table %r is consumed by a "
+                "non-lookup op: its gradient falls back to a DENSE "
+                "[%s] cotangent — the sparse-update guarantee does "
+                "not hold for this table", w,
+                "x".join(str(d) for d in block.var(w).shape))
     sparse_grads = {}  # table name -> (rows var name, values var name)
+    dist_grad_parts = {}  # table name -> [(rows, vals), ...] pre-concat
 
     # Seed: d loss / d loss = ones.
     seed = add_contrib(loss.name)
@@ -173,6 +201,30 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
                        "padding_idx": op.attrs.get("padding_idx")},
                 infer_shape=False)
             sparse_grads[w.name] = (rows_n, vals_n)
+            continue
+        if op.type == "lookup_table_dist" and op.input("W") in dist_tables:
+            g_out = final_grad(op.output("Out"))
+            if g_out is None:
+                continue
+            w = block.var(op.input("W"))
+            k = len(dist_grad_parts.get(w.name, ()))
+            suffix = "" if k == 0 else "@%d" % k
+            rows_n = "%s%s@ROWS%s" % (w.name, GRAD_SUFFIX, suffix)
+            vals_n = "%s%s@VALUES%s" % (w.name, GRAD_SUFFIX, suffix)
+            block.create_var(name=rows_n, dtype="int32",
+                             stop_gradient=True)
+            block.create_var(name=vals_n, dtype=w.dtype,
+                             stop_gradient=True)
+            block.append_op(
+                "lookup_table_dist_grad",
+                inputs={"OutGrad": [g_out], "Ids": [op.input("Ids")]},
+                outputs={"Rows": [rows_n], "Values": [vals_n]},
+                attrs={"vocab_size": op.attrs.get("vocab_size"),
+                       "padded_vocab": int(w.shape[0]),
+                       "padding_idx": op.attrs.get("padding_idx")},
+                infer_shape=False)
+            dist_grad_parts.setdefault(w.name, []).append(
+                (rows_n, vals_n))
             continue
         out_slots = registry.flat_output_slots(op)
         in_slots = registry.flat_input_slots(op)
@@ -210,6 +262,32 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
             outputs={"InGrads": in_grad_names},
             attrs={"fwd_op": op, "fwd_op_type": op.type},
             infer_shape=False)
+
+    # Fold per-consumer distributed sparse grads: a table shared by N
+    # lookups gets its N (rows, values) pairs concatenated along the
+    # nnz axis — the optimizer's merge/scatter sums duplicates, so the
+    # result equals the dense sum of contributions while staying
+    # O(total ids), never O(table).
+    for wname, parts in dist_grad_parts.items():
+        if len(parts) == 1:
+            sparse_grads[wname] = parts[0]
+            continue
+        w = block.var(wname)
+        rows_n = "%s%s@ROWS@CAT" % (wname, GRAD_SUFFIX)
+        vals_n = "%s%s@VALUES@CAT" % (wname, GRAD_SUFFIX)
+        block.create_var(name=rows_n, dtype="int32",
+                         stop_gradient=True)
+        block.create_var(name=vals_n, dtype=w.dtype,
+                         stop_gradient=True)
+        block.append_op("concat",
+                        inputs={"X": [r for r, _ in parts]},
+                        outputs={"Out": [rows_n]},
+                        attrs={"axis": 0}, infer_shape=False)
+        block.append_op("concat",
+                        inputs={"X": [v for _, v in parts]},
+                        outputs={"Out": [vals_n]},
+                        attrs={"axis": 0}, infer_shape=False)
+        sparse_grads[wname] = (rows_n, vals_n)
 
     params_and_grads = []
     for pname in sorted(param_names):

@@ -388,6 +388,11 @@ class Executor:
                 # batch). Staging normally pre-scatters per shard.
                 buf = jax.device_put(buf, self.strategy.replicated())
             for slot in feed.layout:
+                if slot.kind != "dense":
+                    # sparse triples arrive in their final wire dtypes
+                    # (index width ids/offsets, canon values) — no
+                    # widen prologue
+                    continue
                 spec = _ingest_spec(block.var_or_none(slot.name),
                                     slot.dtype, slot.name, packed=True)
                 if spec is not None:
@@ -397,6 +402,7 @@ class Executor:
             feed_sig = (("@packed@",) + packed_sig,)
         else:
             feed_arrays = {}
+            feed = _ingest.explode_sparse(feed)
             for name, value in feed.items():
                 var = block.var_or_none(name)
                 spec = _ingest_spec(var, getattr(value, "dtype",
@@ -420,14 +426,24 @@ class Executor:
         amp = _config.get_flag("amp")
         flash = bool(_config.get_flag("flash_attention"))
         precision = _config.get_flag("matmul_precision")
+        telemetry = bool(_config.get_flag("telemetry"))
+        # distributed-embedding flags are trace-time too (layout,
+        # a2a route, telemetry callbacks) but are consulted ONLY for
+        # programs that registered a DistEmbedding table — the default
+        # path pays one getattr, zero flag reads
+        emb_tables = getattr(program, "_dist_embeddings", None)
+        emb_key = None
+        if emb_tables:
+            emb_key = (bool(_config.get_flag("embedding_shard_rows")),
+                       bool(_config.get_flag("embedding_a2a")),
+                       telemetry)
         # every trace-time flag must key the compile cache; the ingest
         # prologue (wire widening + packed unpack) is trace-time too
         key = (program._uid, program._version, feed_sig, tuple(fetch_names),
                bool(donate_state),
                self.strategy._uid if self.strategy is not None else None,
                check_nan_inf, amp, flash, precision, nonfinite_guard,
-               ingest_specs)
-        telemetry = bool(_config.get_flag("telemetry"))
+               ingest_specs, emb_key)
         entry = self._cache.get(key)
         if entry is None:
             self._compiles += 1
@@ -476,9 +492,13 @@ class Executor:
             feed_arrays = {n: a if n == _ingest.PACKED_FEED
                            else self.strategy.shard_feed(n, a)
                            for n, a in feed_arrays.items()}
-            state_rw = {n: self.strategy.shard_state(n, a)
+            dist_rows = None
+            if emb_key is not None and emb_key[0]:
+                dist_rows = {n: info["padded"]
+                             for n, info in emb_tables.items()}
+            state_rw = {n: self.strategy.shard_state(n, a, dist_rows)
                         for n, a in state_rw.items()}
-            state_ro = {n: self.strategy.shard_state(n, a)
+            state_ro = {n: self.strategy.shard_state(n, a, dist_rows)
                         for n, a in state_ro.items()}
         return entry, state_rw, state_ro, feed_arrays
 

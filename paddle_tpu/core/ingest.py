@@ -28,8 +28,9 @@ import numpy as np
 
 from .framework import convert_dtype
 
-__all__ = ["FeedSlot", "PackedBatch", "PACKED_FEED", "plan_layout",
-           "pack_feed", "unpack", "widen", "canon_norm"]
+__all__ = ["FeedSlot", "PackedBatch", "PACKED_FEED", "SparseTriple",
+           "plan_layout", "pack_feed", "unpack", "widen", "canon_norm",
+           "explode_sparse"]
 
 # Reserved feed name the executor binds a PackedBatch's buffer to.
 PACKED_FEED = "@PACKED_FEED@"
@@ -42,10 +43,77 @@ _ALIGN = 64
 # One packed slot, all static: name, wire dtype (str), rows per shard,
 # per-sample trailing shape, byte offset/extent within one shard row.
 # The tuple is the compile-cache signature — two batches with the same
-# layout share one executor entry.
+# layout share one executor entry. ``kind`` is "dense" or "sparse";
+# sparse slots carry a ragged (ids, offsets, values) triple in one
+# byte range with ``aux = (cap, n_offsets, index_dtype)`` (cap = the
+# pow-2 nnz bucket the ids/values are padded to, so distinct nnz
+# counts collapse onto a bounded set of compile signatures).
 FeedSlot = collections.namedtuple(
     "FeedSlot", ["name", "dtype", "rows", "sample_shape", "offset",
-                 "nbytes"])
+                 "nbytes", "kind", "aux"],
+    defaults=("dense", None))
+
+# A ragged sparse feed: CSR-style ids [nnz] / offsets [batch+1] /
+# values [nnz]. As a feed-dict value under key ``name`` it packs as ONE
+# slot of the single-copy wire (ids/offsets in the index wire width)
+# and unpacks inside the step as the three feeds ``name``,
+# ``name@offsets``, ``name@values`` — declare data vars with those
+# names to consume it. This is what keeps recsys batches on the
+# one-H2D-per-batch property: the [batch+1] offsets array's ragged
+# leading dim used to force the whole batch onto the per-array path.
+SparseTriple = collections.namedtuple(
+    "SparseTriple", ["ids", "offsets", "values"])
+
+# nnz bucket floor for sparse slots: pad to the next power of two, at
+# least this, so the packed layout (= compile signature) is closed.
+_SPARSE_MIN_CAP = 64
+
+
+def _sparse_cap(nnz):
+    cap = _SPARSE_MIN_CAP
+    while cap < nnz:
+        cap *= 2
+    return cap
+
+
+def _pad_tail(arr, cap):
+    if arr.shape[0] == cap:
+        return arr
+    out = np.zeros((cap,) + arr.shape[1:], arr.dtype)
+    out[:arr.shape[0]] = arr
+    return out
+
+
+def _canon_triple(v):
+    """Canonicalize a SparseTriple for the wire: ids/offsets in the
+    index wire width, 1-D; values in their own canon dtype."""
+    ids = _canon_array(v.ids).reshape(-1)
+    offs = _canon_array(v.offsets).reshape(-1)
+    vals = _canon_array(v.values).reshape(-1)
+    if ids.shape[0] != vals.shape[0]:
+        raise ValueError("sparse triple ids/values length mismatch "
+                         "(%d vs %d)" % (ids.shape[0], vals.shape[0]))
+    return ids, offs, vals
+
+
+def explode_sparse(feed):
+    """Replace each SparseTriple value with its three named arrays
+    (ids padded to the pow-2 cap, so the per-array path sees the same
+    closed shape set as the packed wire). No-op passthrough for feeds
+    without triples."""
+    if not any(isinstance(v, SparseTriple) for v in feed.values()):
+        return feed
+    out = {}
+    for name, v in feed.items():
+        if isinstance(v, SparseTriple):
+            ids, offs, vals = _canon_triple(v)
+            cap = _sparse_cap(ids.shape[0])
+            out[name] = _pad_tail(ids, cap)
+            out[name + "@offsets"] = offs
+            out[name + "@values"] = _pad_tail(vals, cap)
+        else:
+            out[name] = v
+    return out
 
 
 class PackedBatch:
@@ -96,13 +164,28 @@ def _align(n):
 def plan_layout(feed, shards=1):
     """(arrays, layout, shard_nbytes, batch) for a packable feed dict,
     or None when the batch can't be packed (caller falls back to the
-    per-array path): empty arrays, mismatched leading dims, or a batch
-    the shard count doesn't divide."""
+    per-array path): empty arrays, mismatched leading dims, a batch
+    the shard count doesn't divide, or a sparse triple under a
+    multi-shard scatter (ragged nnz doesn't split row-wise)."""
     if not feed:
         return None
     arrays, batch = {}, None
     for name in sorted(feed):
-        arr = _canon_array(feed[name])
+        value = feed[name]
+        if isinstance(value, SparseTriple):
+            if shards != 1:
+                return None
+            ids, offs, vals = _canon_triple(value)
+            if offs.shape[0] < 2:
+                return None
+            b = offs.shape[0] - 1
+            if batch is None:
+                batch = b
+            elif b != batch:
+                return None
+            arrays[name] = (ids, offs, vals)
+            continue
+        arr = _canon_array(value)
         if arr.ndim == 0 or arr.nbytes == 0:
             return None
         if batch is None:
@@ -115,6 +198,17 @@ def plan_layout(feed, shards=1):
     rows = batch // shards
     layout, off = [], 0
     for name, arr in arrays.items():
+        if isinstance(arr, tuple):
+            ids, offs, vals = arr
+            cap = _sparse_cap(ids.shape[0])
+            nb = (offs.nbytes + cap * ids.itemsize
+                  + cap * vals.itemsize)
+            layout.append(FeedSlot(
+                name, np.dtype(vals.dtype).name, batch, (), off, nb,
+                kind="sparse",
+                aux=(cap, offs.shape[0], np.dtype(ids.dtype).name)))
+            off = _align(off + nb)
+            continue
         if arr.nbytes % shards:
             return None
         nb = arr.nbytes // shards
@@ -142,6 +236,18 @@ def pack_feed(feed, shards=1, alloc=None):
     rows = batch // shards
     for slot in layout:
         arr = arrays[slot.name]
+        if slot.kind == "sparse":  # shards == 1 (plan enforces)
+            ids, offs, vals = arr
+            cap = slot.aux[0]
+            seg = buf2d[0, slot.offset:slot.offset + slot.nbytes]
+            o_nb = offs.nbytes
+            i_nb = cap * ids.itemsize
+            seg[:o_nb].view(offs.dtype)[:] = offs
+            seg[o_nb:o_nb + i_nb].view(ids.dtype)[:] = \
+                _pad_tail(ids, cap)
+            seg[o_nb + i_nb:o_nb + i_nb + cap * vals.itemsize] \
+                .view(vals.dtype)[:] = _pad_tail(vals, cap)
+            continue
         for s in range(shards):
             dst = buf2d[s, slot.offset:slot.offset + slot.nbytes] \
                 .view(arr.dtype).reshape((rows,) + slot.sample_shape)
@@ -158,11 +264,33 @@ def unpack(buf, layout):
     import jax
     shards = buf.shape[0]
     out = {}
+
+    def _cast(seg, dt):
+        k = np.dtype(dt).itemsize
+        if k > 1:
+            return jax.lax.bitcast_convert_type(
+                seg.reshape(-1, k), dt).reshape(-1)
+        if np.dtype(dt) != np.uint8:
+            return jax.lax.bitcast_convert_type(seg, dt)
+        return seg
+
     for slot in layout:
         dt = convert_dtype(slot.dtype)
-        k = np.dtype(dt).itemsize
         seg = jax.lax.slice_in_dim(buf, slot.offset,
                                    slot.offset + slot.nbytes, axis=1)
+        if slot.kind == "sparse":
+            cap, n_off, idt_name = slot.aux
+            idt = convert_dtype(idt_name)
+            isz = np.dtype(idt).itemsize
+            flat = seg.reshape(-1)  # shards == 1 on the sparse wire
+            o_nb, i_nb = n_off * isz, cap * isz
+            out[slot.name + "@offsets"] = _cast(flat[:o_nb], idt)
+            out[slot.name] = _cast(flat[o_nb:o_nb + i_nb], idt)
+            out[slot.name + "@values"] = _cast(
+                flat[o_nb + i_nb:o_nb + i_nb
+                     + cap * np.dtype(dt).itemsize], dt)
+            continue
+        k = np.dtype(dt).itemsize
         if k > 1:
             seg = jax.lax.bitcast_convert_type(
                 seg.reshape(shards, slot.nbytes // k, k), dt)

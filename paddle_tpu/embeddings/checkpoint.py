@@ -1,0 +1,76 @@
+"""Checkpoint layout metadata + reshard for distributed embedding tables.
+
+A distributed table's scope value is stored in mod-interleaved
+(shard-major) layout for whatever shard count the saving strategy used
+(sharded.py). Because the padded vocab is shard-count-independent
+(PAD_MULTIPLE), resharding across an elastic resize (PR 6:
+``resize_strategy`` re-keys the mesh) is a pure row PERMUTATION — the
+array shape, the program, and the executor compile entries all survive.
+
+Protocol:
+
+* save side — pass :func:`layout_meta` as ``extra_meta`` to
+  ``io.save_checkpoint``: the digest-verified checkpoint's
+  ``latest.json`` then carries each table's (and each registered
+  optimizer slot's) shard count alongside the resume metadata.
+* restore side — after ``io.load_checkpoint`` put the raw (old-layout)
+  arrays in the scope, call :func:`reshard_scope` with the saved
+  layout and the NEW strategy: every table whose shard count changed is
+  re-permuted old->logical->new, optimizer slots included, row-exactly.
+"""
+
+import numpy as np
+
+from .sharded import active_shards, to_logical, to_shard_major
+
+__all__ = ["layout_meta", "reshard_scope", "reshard_array"]
+
+META_KEY = "embedding_layout"
+
+
+def layout_meta(program, strategy=None):
+    """``extra_meta`` dict for ``io.save_checkpoint``: the shard layout
+    every registered distributed table (and optimizer slot) is stored
+    in under ``strategy``."""
+    tables = getattr(program, "_dist_embeddings", None) or {}
+    out = {}
+    for name, info in tables.items():
+        n, _, _ = active_shards(strategy, info["padded"])
+        out[name] = {"num_shards": int(n), "vocab": int(info["vocab"]),
+                     "padded": int(info["padded"]),
+                     "dim": int(info["dim"]),
+                     "slot_of": info.get("slot_of")}
+    return {META_KEY: out}
+
+
+def reshard_array(arr, old_shards, new_shards):
+    """Re-permute one shard-major array across a shard-count change."""
+    old_n, new_n = int(old_shards), int(new_shards)
+    if old_n == new_n:
+        return np.asarray(arr)
+    return to_shard_major(to_logical(arr, old_n), new_n)
+
+
+def reshard_scope(scope, layout, strategy=None):
+    """Re-key every restored table in ``scope`` from its saved shard
+    count (``layout`` = the ``embedding_layout`` entry of
+    ``io.load_checkpoint_meta``, or a full meta dict) to the count
+    ``strategy`` implies. Row-shaped optimizer slots ride along; [1]
+    accumulators (Adam beta powers) were never registered and pass
+    through untouched. Returns the number of re-permuted arrays."""
+    if layout and META_KEY in layout:
+        layout = layout[META_KEY]
+    moved = 0
+    for name, info in (layout or {}).items():
+        if not scope.has_var(name):
+            continue
+        old_n = int(info.get("num_shards", 1))
+        new_n, _, _ = active_shards(strategy, int(info["padded"]))
+        if old_n == new_n:
+            continue
+        arr = np.asarray(scope.find_var(name))
+        if arr.ndim < 1 or arr.shape[0] != int(info["padded"]):
+            continue  # defensive: registry drift / foreign var
+        scope.set_var(name, reshard_array(arr, old_n, new_n))
+        moved += 1
+    return moved

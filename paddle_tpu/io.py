@@ -397,6 +397,15 @@ def prune_program(program, fetch_names):
         op_map[op] = new_op
         nb.ops.append(new_op)
     new_prog._bump_version()
+    # carry the DistEmbedding registry for surviving tables, so a
+    # pruned (inference) program keeps its layout metadata — a loader
+    # can reshard_scope the shard-major values to its own shard count
+    tables = getattr(program, "_dist_embeddings", None)
+    if tables:
+        kept = {n: dict(info) for n, info in tables.items()
+                if nb.has_var(n)}
+        if kept:
+            new_prog._dist_embeddings = kept
     return new_prog
 
 

@@ -81,13 +81,42 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 def embedding(input, size, is_sparse=False, padding_idx=None,
               param_attr=None, dtype="float32", name=None,
-              keep_dims=False, **kwargs):
+              keep_dims=False, is_distributed=False, **kwargs):
     """Embedding lookup (reference lookup_table_op). With
     ``is_sparse=True`` the table's gradient is a SelectedRows-style
     (rows, values) pair — never a dense [V, D] buffer — and
     SGD/Momentum/Adagrad/Adam apply row-wise scatter updates
-    (ops/sparse_ops.py; reference selected_rows.h)."""
+    (ops/sparse_ops.py; reference selected_rows.h).
+
+    ``is_distributed=True`` creates a DistEmbedding table (the pserver
+    seam, embeddings/sharded.py): storage is one [padded_vocab, dim]
+    array in mod-interleaved layout that DistStrategy row-shards over
+    the mesh (``row_id % num_shards`` ownership, flag
+    ``embedding_shard_rows``), lookup/gradient exchange runs as a
+    two-hop ICI all_to_all inside the jitted step (flag
+    ``embedding_a2a``), and the gradient is ALWAYS the sparse
+    (rows, values) form. On a single device (or with the flags off) it
+    degrades to a numerically identical dense lookup."""
     helper = LayerHelper("embedding", name=name, **kwargs)
+    if is_distributed:
+        from ..embeddings import sharded as _sharded
+        vocab, dim = int(size[0]), int(size[1])
+        vp = _sharded.padded_vocab(vocab)
+        w = helper.create_parameter(
+            param_attr, shape=[vp, dim], dtype=dtype,
+            default_initializer=NormalInitializer(0.0,
+                                                  1.0 / np.sqrt(dim)))
+        _sharded.register_table(helper.main_program, w.name,
+                                vocab=vocab, padded=vp, dim=dim)
+        out = helper.create_tmp_variable(dtype)
+        helper.append_op(type="lookup_table_dist",
+                         inputs={"W": [w.name], "Ids": [input.name]},
+                         outputs={"Out": [out.name]},
+                         attrs={"padding_idx": padding_idx,
+                                "vocab_size": vocab,
+                                "padded_vocab": vp,
+                                "keep_dims": bool(keep_dims)})
+        return out
     w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype,
                                 default_initializer=NormalInitializer(
                                     0.0, 1.0 / np.sqrt(size[1])))

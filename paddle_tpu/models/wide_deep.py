@@ -1,8 +1,15 @@
 """Wide&Deep CTR model (BASELINE config #5 — the sparse/pserver workload;
 reference capability: sparse-row embeddings + SparseRemoteParameterUpdater,
-SURVEY §2.3). TPU-native: vocab-sharded embedding tables via
-parallel.DistStrategy param_rules (shard the vocab dim over the 'model'
-axis); gradients become XLA scatter-adds + collectives."""
+SURVEY §2.3). TPU-native, two table regimes:
+
+* ``is_sparse=True`` — SelectedRows gradients + GSPMD vocab sharding via
+  DistStrategy param_rules (:func:`vocab_shard_rules`).
+* ``is_distributed=True`` — DistEmbedding tables (embeddings/sharded.py):
+  mod-interleaved row sharding over the mesh with two-hop ICI all_to_all
+  lookup/gradient exchange — the recsys workload whose parameters don't
+  fit one chip. Placement is automatic (the tables register themselves);
+  no param_rules needed.
+"""
 
 from .. import layers
 
@@ -10,15 +17,19 @@ __all__ = ["wide_deep", "vocab_shard_rules"]
 
 
 def wide_deep(sparse_ids, dense_feats, label, vocab_size, num_slots,
-              emb_dim=16, hidden=(64, 32), is_sparse=True):
+              emb_dim=16, hidden=(64, 32), is_sparse=True,
+              is_distributed=False):
     """sparse_ids: [N, num_slots] int (one id per slot);
     dense_feats: [N, D] float; label: [N, 1] float (click).
     ``is_sparse`` routes the embedding tables through the SelectedRows
-    gradient path (rows+values, row-wise optimizer scatter)."""
+    gradient path (rows+values, row-wise optimizer scatter);
+    ``is_distributed`` upgrades them to row-sharded DistEmbedding
+    tables exchanged over ICI all_to_all (sparse gradients always)."""
     # deep: shared embedding table over all slots
     emb = layers.embedding(sparse_ids, size=[vocab_size, emb_dim],
                            param_attr="deep_embedding",
-                           is_sparse=is_sparse)
+                           is_sparse=is_sparse,
+                           is_distributed=is_distributed)
     deep = layers.reshape(emb, [-1, num_slots * emb_dim])
     deep = layers.concat([deep, dense_feats], axis=1)
     for i, h in enumerate(hidden):
@@ -28,7 +39,8 @@ def wide_deep(sparse_ids, dense_feats, label, vocab_size, num_slots,
     # wide: linear over one-hot ids == a [vocab, 1] embedding sum + dense fc
     wide_emb = layers.embedding(sparse_ids, size=[vocab_size, 1],
                                 param_attr="wide_embedding",
-                                is_sparse=is_sparse)
+                                is_sparse=is_sparse,
+                                is_distributed=is_distributed)
     wide_sum = layers.reduce_sum(wide_emb, dim=1)
     wide_dense = layers.fc(dense_feats, 1, bias_attr=False)
     logit = layers.elementwise_add(
@@ -43,6 +55,8 @@ def vocab_shard_rules(axis="model"):
     """DistStrategy param_rules sharding both embedding tables (and their
     optimizer accumulators, which inherit the param-name prefix) on the
     vocab dim — no device ever holds a full table (reference capability:
-    pserver sparse shards, SparseParameterDistribution.cpp)."""
+    pserver sparse shards, SparseParameterDistribution.cpp). The
+    ``is_distributed`` regime doesn't need these: DistEmbedding tables
+    place themselves."""
     from .. import parallel
     return [(r"(deep|wide)_embedding", parallel.P(axis, None))]

@@ -33,7 +33,16 @@ def merge_duplicate_rows(rows, vals, vocab_size):
     Returns (merged_rows, merged_vals) of the SAME length: the first
     occurrence slot of each unique row carries the summed value; the
     remaining slots get row index == vocab_size (out of range, dropped by
-    scatter mode='drop')."""
+    scatter mode='drop').
+
+    Shape-stable at the edges the recsys path hits: an EMPTY rows
+    array returns (rows, vals) unchanged (the cumsum/segment machinery
+    would otherwise broadcast a length-1 start marker against zero
+    segments and fail under jit), and an all-duplicate batch compacts
+    into slot 0 with every other slot pushed out of range — both with
+    input-shaped (pad-to-static) outputs."""
+    if rows.shape[0] == 0:
+        return rows.astype(jnp.int32), vals
     order = jnp.argsort(rows)
     r = rows[order]
     v = vals[order]

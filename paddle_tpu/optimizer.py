@@ -76,6 +76,19 @@ class Optimizer:
         shape = list(shape if shape is not None else param.shape)
         vname = unique_name.generate("%s_%s_acc" % (param.name, name))
         block = main.global_block()
+        # A distributed embedding table's row-shaped slots (Adam
+        # moments etc.) are registered alongside it, so DistStrategy
+        # row-shards them by the same rule and checkpoint reshard
+        # re-permutes them with the table. Scalar slots (beta powers,
+        # shape [1]) stay replicated.
+        tables = getattr(main, "_dist_embeddings", None)
+        if tables is not None and param.name in tables and \
+                tables[param.name].get("slot_of") is None and \
+                shape and shape[0] == tables[param.name]["padded"]:
+            info = tables[param.name]
+            tables[vname] = {"vocab": info["vocab"],
+                             "padded": info["padded"],
+                             "dim": info["dim"], "slot_of": param.name}
         var = block.create_var(name=vname, shape=shape, dtype=param.dtype,
                                persistable=True, stop_gradient=True)
         svar = startup.global_block().create_var(

@@ -101,7 +101,20 @@ class DistStrategy:
             return self.replicated()
         return self._named(P(self.data_axis, *([None] * (ndim - 1))))
 
-    def state_sharding(self, name, ndim, shape=None):
+    def state_sharding(self, name, ndim, shape=None, dist_rows=None):
+        """dist_rows: {var name -> padded row count} of distributed
+        embedding tables (+ their row-shaped optimizer slots) to place
+        row-sharded over the data axis — the executor passes the
+        program's DistEmbedding registry here when
+        ``embedding_shard_rows`` is armed. Row 0 of the mod-interleaved
+        layout then lands on the device that owns ids ≡ 0 (mod n):
+        block placement IS the pserver hash placement."""
+        if dist_rows and name in dist_rows and ndim >= 1 and \
+                self.data_axis is not None and shape is not None and \
+                shape[0] == dist_rows[name] and \
+                shape[0] % self.data_shards() == 0:
+            return self._named(
+                P(self.data_axis, *([None] * (ndim - 1))))
         for pat, spec in self.param_rules:
             if pat.search(name):
                 spec_t = tuple(spec)
@@ -178,10 +191,11 @@ class DistStrategy:
                 "(logged once)", buf.shape[0], self.data_shards())
         return self._scatter_host(buf, self.replicated())
 
-    def shard_state(self, name, array):
+    def shard_state(self, name, array, dist_rows=None):
         return jax.device_put(array,
                               self.state_sharding(name, np.ndim(array),
-                                                  np.shape(array)))
+                                                  np.shape(array),
+                                                  dist_rows))
 
 
 from .ring_attention import ring_attention, dense_attention  # noqa: E402

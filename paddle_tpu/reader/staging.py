@@ -84,6 +84,10 @@ _TRANSFERS = _metrics.REGISTRY.counter(
     "paddle_staging_h2d_transfers_total",
     "device_put dispatches issued by staging (packed path: one per "
     "batch per mesh shard)")
+_SPARSE_SLOTS = _metrics.REGISTRY.counter(
+    "paddle_staging_sparse_slots_total",
+    "Ragged (ids, offsets, values) sparse slots carried on the packed "
+    "wire — batches that would otherwise fall back to per-array H2D")
 _READER_IDS = itertools.count(1)
 
 
@@ -239,7 +243,11 @@ class StagedReader:
         if telemetry:
             _LEGACY_BYTES.inc(sum(
                 self._legacy_nbytes(n, np.asarray(v))
-                for n, v in feed.items()))
+                for n, v in feed.items()
+                if not isinstance(v, _ingest.SparseTriple)))
+            n_sparse = sum(1 for s in pb.layout if s.kind == "sparse")
+            if n_sparse:
+                _SPARSE_SLOTS.inc(n_sparse)
         if self.device_put:
             import jax
             if self.strategy is not None:
@@ -269,6 +277,9 @@ class StagedReader:
             out = self._stage_packed(feed)
             if out is not None:
                 return out
+        # per-array fallback: ragged sparse triples become their three
+        # cap-padded named arrays (core/ingest.explode_sparse)
+        feed = _ingest.explode_sparse(feed)
         telemetry = _config.get_flag("telemetry")
         staged, ptrs = {}, []
         for name, value in feed.items():

@@ -351,3 +351,111 @@ def test_feeder_integer_padded_buffers_not_f32():
     assert out["seq"].dtype == np.int32
     padded, lengths = pad_batch([[1, 2], [3]])
     assert np.issubdtype(padded.dtype, np.integer)
+
+
+# -- sparse (ids, offsets, values) triples on the packed wire ------------
+# (ISSUE 14 satellite: the [batch+1] offsets array's ragged leading dim
+# used to force the whole batch off the single-copy path)
+
+def _triple(batch=6, nnz=17, seed=3):
+    rs = np.random.RandomState(seed)
+    ids = rs.randint(0, 500, (nnz,)).astype("int64")
+    cuts = np.sort(rs.choice(np.arange(1, nnz), batch - 1,
+                             replace=False))
+    offsets = np.concatenate([[0], cuts, [nnz]]).astype("int64")
+    values = rs.randn(nnz).astype("float32")
+    return ingest.SparseTriple(ids, offsets, values)
+
+
+def test_sparse_triple_packs_in_one_block():
+    feed = {"x": np.random.RandomState(0).randn(6, 4).astype("float32"),
+            "bag": _triple()}
+    pb, handle = ingest.pack_feed(feed)
+    assert pb is not None and pb.batch_size == 6
+    sparse = [s for s in pb.layout if s.kind == "sparse"]
+    assert len(sparse) == 1 and sparse[0].name == "bag"
+    cap = sparse[0].aux[0]
+    assert cap == 64  # nnz 17 -> pow-2 floor bucket
+    out = ingest.unpack(jnp.asarray(pb.buffer), pb.layout)
+    trip = _triple()
+    np.testing.assert_array_equal(np.asarray(out["bag"])[:17],
+                                  trip.ids.astype("int32"))
+    assert np.asarray(out["bag"]).shape == (cap,)
+    np.testing.assert_array_equal(np.asarray(out["bag@offsets"]),
+                                  trip.offsets.astype("int32"))
+    np.testing.assert_array_equal(np.asarray(out["bag@values"])[:17],
+                                  trip.values)
+    np.testing.assert_array_equal(np.asarray(out["x"]), feed["x"])
+
+
+def test_sparse_triple_executor_packed_vs_dict_feed():
+    """A program consuming the three derived feeds computes the same
+    value from the packed wire and from the per-array (exploded)
+    dict-feed fallback."""
+    with ptpu.scope_guard(ptpu.Scope()), ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            vals = layers.data("bag@values", shape=[64],
+                               append_batch_size=False)
+            layers.data("bag@offsets", shape=[7],
+                        append_batch_size=False, dtype="int64")
+            out = layers.reduce_sum(vals, dim=0)
+        exe = ptpu.Executor()
+        trip = _triple()
+        x = np.random.RandomState(1).randn(6, 4).astype("float32")
+        pb, _ = ingest.pack_feed({"x": x, "bag": trip})
+        got_packed = np.asarray(
+            exe.run(main, feed=pb, fetch_list=[out])[0])
+        got_dict = np.asarray(
+            exe.run(main, feed={"x": x, "bag": trip},
+                    fetch_list=[out])[0])
+    want = trip.values.sum(dtype=np.float64).astype(np.float32)
+    np.testing.assert_allclose(got_packed, want, rtol=1e-5)
+    np.testing.assert_allclose(got_dict, want, rtol=1e-5)
+
+
+def test_sparse_triple_multi_shard_falls_back():
+    """Ragged nnz doesn't split row-wise: a sparse slot under a
+    multi-shard scatter refuses to pack (per-array fallback)."""
+    feed = {"x": np.zeros((8, 2), "float32"), "bag": _triple(batch=8)}
+    assert ingest.plan_layout(feed, shards=2) is None
+    assert ingest.pack_feed(feed, shards=2) is None
+
+
+def test_sparse_triple_staged_one_h2d_and_counter():
+    from paddle_tpu.reader import staging as _staging
+    trip = _triple()
+    batches = [{"x": np.random.RandomState(i).randn(6, 4)
+                .astype("float32"), "bag": trip} for i in range(3)]
+
+    def reader():
+        return iter([dict(b) for b in batches])
+
+    prev = {k: ptpu.config.get_flag(k)
+            for k in ("packed_feeds", "telemetry")}
+    ptpu.config.set_flags(packed_feeds=True, telemetry=True)
+    try:
+        t0 = _staging._TRANSFERS.value
+        s0 = _staging._SPARSE_SLOTS.value
+        sr = StagedReader(reader)
+        staged = list(sr())
+        sr.close()
+        assert len(staged) == 3
+        assert all(isinstance(s, ingest.PackedBatch) for s in staged)
+        # one H2D per batch even with the ragged sparse slot aboard
+        assert _staging._TRANSFERS.value - t0 == 3
+        assert _staging._SPARSE_SLOTS.value - s0 == 3
+    finally:
+        ptpu.config.set_flags(**prev)
+
+
+def test_explode_sparse_passthrough_and_padding():
+    feed = {"x": np.ones((2, 2), "float32")}
+    assert ingest.explode_sparse(feed) is feed  # no triple, no copy
+    trip = _triple(batch=2, nnz=5)
+    out = ingest.explode_sparse({"bag": trip})
+    assert out["bag"].shape == (64,) and out["bag"].dtype == np.int32
+    assert out["bag@values"].shape == (64,)
+    np.testing.assert_array_equal(out["bag"][:5],
+                                  trip.ids.astype("int32"))
+    assert (out["bag"][5:] == 0).all()
