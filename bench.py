@@ -1304,6 +1304,109 @@ def bench_recsys(on_accel):
     ]
 
 
+def bench_slo(on_accel):
+    """Telemetry-plane costs and guarantees (ISSUE 16), tripwired:
+
+    * ``slo_detection_latency_ms`` — simulated-clock time from a
+      latency fault starting to the fast-window burn-rate alert
+      tripping, on an SLOTracker at default windows ticked at the
+      serving monitor cadence. Deterministic (the clock is driven, not
+      read), so the wire catches an algorithmic regression in the
+      multi-window burn math — not host jitter.
+    * ``metrics_aggregation_overhead_pct`` — what one member's
+      telemetry cycle (bounded snapshot build + encode + router-side
+      ingest) costs relative to a 1 s ship interval, on a registry
+      populated to a realistic fleet cardinality. The whole plane must
+      stay a rounding error next to the work it observes."""
+    from paddle_tpu.observability import aggregate, metrics, slo
+    from paddle_tpu.serving import wire
+
+    suffix = "" if on_accel else "_cpu_smoke"
+
+    # -- detection latency (simulated clock) ---------------------------
+    reg = metrics.Registry()
+    hist = reg.histogram("paddle_bench_slo_e2e_ms", "bench latencies",
+                         buckets=metrics.LATENCY_MS_BUCKETS)
+    tracker = slo.SLOTracker(
+        label="bench", target_p99_ms=100.0,
+        source=slo.local_source(histogram="paddle_bench_slo_e2e_ms",
+                                registry=reg))
+    tick_s = 0.25  # the serving monitor-loop cadence
+    now = 0.0
+    tracker.tick(now)
+    while now < 90.0:  # healthy history filling both windows
+        now += tick_s
+        for _ in range(8):
+            hist.observe(10.0)
+        tracker.tick(now)
+    fault_start = now
+    detected = None
+    while now < fault_start + 60.0:
+        now += tick_s
+        for _ in range(8):
+            hist.observe(800.0)  # the fault: everything over target
+        tracker.tick(now)
+        if tracker.alerting:
+            detected = now
+            break
+    tracker.close()
+    if detected is None:
+        raise RuntimeError("fast-window burn alert never tripped "
+                           "under a total latency fault")
+    detection_ms = (detected - fault_start) * 1e3
+
+    # -- aggregation overhead (real clock) -----------------------------
+    reg = metrics.Registry()
+    for i in range(20):
+        c = reg.counter("paddle_bench_c%d_total" % i, "c",
+                        labelnames=("route",))
+        for j in range(16):
+            c.labels(route="r%02d" % j).inc(j + 1)
+    for i in range(6):
+        h = reg.histogram("paddle_bench_h%d_ms" % i, "h",
+                          labelnames=("route",),
+                          buckets=metrics.LATENCY_MS_BUCKETS)
+        for j in range(16):
+            h.labels(route="r%02d" % j).observe(float(7 * j % 90))
+    agg = aggregate.FleetAggregator("bench",
+                                    registry=metrics.Registry())
+    reps = 50 if on_accel else 20
+    budget = wire.MAX_LINE - 1024
+    t0 = time.perf_counter()
+    for i in range(reps):
+        snap = aggregate.build_snapshot(max_bytes=budget, registry=reg)
+        aggregate.encode_snapshot(snap)
+        agg.ingest("m0", "i1", snap)
+    cycle_s = (time.perf_counter() - t0) / reps
+    interval_s = 1.0
+    overhead_pct = cycle_s / interval_s * 100.0
+
+    return [{
+        "metric": "slo_detection_latency_ms" + suffix,
+        "value": round(detection_ms, 1),
+        "unit": "ms fault-start -> fast-window burn alert "
+                "(simulated clock, %g s ticks, default windows)"
+                % tick_s,
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        "fast_window_s": tracker.windows[0],
+        "tick_s": tick_s,
+    }, {
+        "metric": "metrics_aggregation_overhead_pct" + suffix,
+        "value": round(overhead_pct, 3),
+        "unit": "% of a 1 s ship interval spent on snapshot build + "
+                "encode + ingest (realistic fleet cardinality)",
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        "cycle_ms": round(cycle_s * 1e3, 3),
+        "families": 26,
+        "children": 26 * 16,
+        # sub-ms cycles on a shared CPU rig: scheduler jitter swings
+        # the percentage; only an actual cost blowup should trip
+        "regression_floor": 2.0,
+    }]
+
+
 def bench_elastic_resume():
     """Measure the elastic control plane's recovery latency on this
     host: a registered peer goes silent, the master declares it dead
@@ -1440,7 +1543,9 @@ def main():
             ("fleet_p99_under_kill_ms",
              lambda: bench_fleet(on_accel)),
             ("recsys_examples_per_sec",
-             lambda: bench_recsys(on_accel))]:
+             lambda: bench_recsys(on_accel)),
+            ("slo_detection_latency_ms",
+             lambda: bench_slo(on_accel))]:
         try:
             out = _isolated(fn)
             for line in (out if isinstance(out, list) else [out]):

@@ -251,6 +251,23 @@ fleet_canary_fraction: the share of live traffic a freshly-swapped
   the fleet keeps serving the stable version). Read only at router
   construction.
 
+fleet_metrics_interval_ms: cadence at which an EngineWorker
+  piggybacks a mergeable registry snapshot (observability/
+  aggregate.py) on its membership heartbeat, for the router-side
+  FleetAggregator to fold in with per-(member, incarnation) delta
+  accounting. 0 (default): no snapshots ship and the heartbeat frames
+  stay byte-identical. Read only inside the fleet constructors.
+
+slo_target_p99_ms: the latency objective an SLOTracker
+  (observability/slo.py) judges requests against — observations above
+  it (plus shed/deadline events) burn the error budget. 0 (default):
+  the fleet router constructs no tracker. Read only at construction.
+
+slo_windows: the SLO burn-rate window widths in seconds, shortest
+  first (the multi-window SRE convention: the fast window trips the
+  alert, the slow window confirms it is sustained). Read only at
+  tracker construction.
+
 embedding_shard_rows: if True, DistEmbedding tables created by
   ``layers.embedding(..., is_distributed=True)`` are row-sharded over
   the mesh data axis by ``row_id % num_shards`` (mod-interleaved
@@ -339,6 +356,13 @@ _flags = {
     "fleet_heartbeat_ms": 1000.0,
     "fleet_members_min": 1,
     "fleet_canary_fraction": 0.25,
+    # fleet telemetry plane (observability/aggregate.py + slo.py,
+    # wired in serving/fleet.py; read only inside the fleet
+    # constructors — 0 disables snapshot shipping / SLO tracking and
+    # keeps the defaults byte-identical)
+    "fleet_metrics_interval_ms": 0.0,
+    "slo_target_p99_ms": 0.0,
+    "slo_windows": (5.0, 60.0),
     # sharded embedding tables (embeddings/sharded.py; read only when a
     # program registered a DistEmbedding — defaults construct none of
     # the subsystem and plain programs never read these)

@@ -73,6 +73,7 @@ class FlightRecorder:
         self.dumps_total = 0
         self.dump_failures = 0
         self._dump_seq = 0
+        self._contexts = {}  # name -> zero-arg context callable
 
     # -- lifecycle (config hook) ----------------------------------------
     def set_armed(self, on):
@@ -89,6 +90,37 @@ class FlightRecorder:
 
     def clear(self):
         self.ring.clear()
+
+    # -- contexts --------------------------------------------------------
+    def add_context(self, name, fn):
+        """Attach a named context callable: its dict lands under
+        ``bundle["context"][name]`` in every dump (a fleet router
+        registers its membership/breaker/SLO snapshot, so a bundle is
+        diagnosable without a live /debug/fleet). ``fn`` returning
+        None (owner gone — register a weakref closure) drops the
+        context lazily; a raising ``fn`` contributes its error."""
+        with self._lock:
+            self._contexts[name] = fn
+
+    def remove_context(self, name):
+        with self._lock:
+            self._contexts.pop(name, None)
+
+    def _context_snapshot(self):
+        with self._lock:
+            items = list(self._contexts.items())
+        out = {}
+        for name, fn in items:
+            try:
+                doc = fn()
+            except Exception as exc:
+                out[name] = {"error": repr(exc)[:200]}
+                continue
+            if doc is None:
+                self.remove_context(name)
+                continue
+            out[name] = doc
+        return out
 
     # -- dumping ---------------------------------------------------------
     def _dump_dir(self):
@@ -137,6 +169,7 @@ class FlightRecorder:
                 "config": _config_fingerprint(),
                 "events": list(self.ring),
                 "metrics": _metrics.REGISTRY.dump(),
+                "context": self._context_snapshot(),
             }
             d = self._dump_dir()
             # the sequence number disambiguates two dumps landing in

@@ -1,17 +1,26 @@
-"""Component health registry — the /healthz data source, kept free of
-``http.server`` so serving constructors (engines, generation
-schedulers register themselves here) never pay the web-server import
-in processes that never set ``telemetry_port``.
+"""Component health + introspection-provider registries — the
+/healthz, /metrics, /debug/fleet and /debug/slo data sources, kept
+free of ``http.server`` so serving constructors (engines, generation
+schedulers, fleet routers register themselves here) never pay the
+web-server import in processes that never set ``telemetry_port``.
 
 Components register a zero-arg callable returning a dict with at
 least ``{"healthy": bool}``; a callable returning None (its owner was
 garbage-collected — registrants close over a weakref) is dropped
 lazily. Callables must not block: they run on the scrape thread.
+
+The generic provider registry extends the same pattern to the other
+scrape surfaces: a provider is a callable registered under a *kind*
+(``"metrics"`` — fn(member=None) -> exposition text; ``"fleet"`` /
+``"slo"`` — fn() -> JSON-ready dict) and a name; None returns mean
+"my owner is gone" and lazily unregister, exactly like health.
 """
 
 import threading
 
-__all__ = ["register_health", "unregister_health", "health_snapshot"]
+__all__ = ["register_health", "unregister_health", "health_snapshot",
+           "register_provider", "unregister_provider", "providers",
+           "provider_snapshot"]
 
 _HEALTH = {}
 _HEALTH_LOCK = threading.Lock()
@@ -49,3 +58,43 @@ def health_snapshot():
             healthy = False
     return {"status": "ok" if healthy else "degraded",
             "components": components}
+
+
+# -- generic introspection providers ----------------------------------
+_PROVIDERS = {}  # kind -> {name: fn}
+_PROVIDERS_LOCK = threading.Lock()
+
+
+def register_provider(kind, name, fn):
+    """Register an introspection provider (idempotent — latest wins)."""
+    with _PROVIDERS_LOCK:
+        _PROVIDERS.setdefault(kind, {})[name] = fn
+
+
+def unregister_provider(kind, name):
+    with _PROVIDERS_LOCK:
+        _PROVIDERS.get(kind, {}).pop(name, None)
+
+
+def providers(kind):
+    """{name: fn} for ``kind`` (a copy — call outside the lock)."""
+    with _PROVIDERS_LOCK:
+        return dict(_PROVIDERS.get(kind, {}))
+
+
+def provider_snapshot(kind, *args, **kwargs):
+    """Call every ``kind`` provider: {name: result}. A raising
+    provider contributes its error; a None result drops the provider
+    (owner gone — the lazy-unregister rule, shared with health)."""
+    out = {}
+    for name, fn in sorted(providers(kind).items()):
+        try:
+            res = fn(*args, **kwargs)
+        except Exception as exc:
+            out[name] = {"error": repr(exc)[:200]}
+            continue
+        if res is None:
+            unregister_provider(kind, name)
+            continue
+        out[name] = res
+    return out

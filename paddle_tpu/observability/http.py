@@ -9,14 +9,23 @@ imported on the serving paths):
 =====================  ==============================================
 endpoint               payload
 =====================  ==============================================
-``/metrics``           Prometheus text exposition 0.0.4
-                       (``metrics.REGISTRY.expose_text()``)
+``/metrics``           Prometheus text exposition 0.0.4. With a
+                       FleetRouter in-process its fleet-merged view
+                       is served instead (``?member=`` drills into
+                       one member's raw snapshot); otherwise
+                       ``metrics.REGISTRY.expose_text()``
 ``/healthz``           aggregate component health, 200/503 —
                        engines and generation schedulers register
                        themselves via :func:`register_health`
 ``/debug/trace?id=X``  one request's span tree
                        (``request_trace.span_tree``); without ``id``,
-                       the known trace ids (oldest first)
+                       the known trace ids (oldest first); with
+                       ``&fmt=chrome``, the Perfetto-loadable
+                       chrome-trace rendering
+``/debug/fleet``       fleet membership/generation/breaker/load +
+                       telemetry snapshot ages (the "fleet"
+                       introspection providers)
+``/debug/slo``         the SLO tracker's machine-readable verdict
 ``/debug/flight``      the latest flight-recorder bundle
 =====================  ==============================================
 
@@ -45,8 +54,9 @@ from . import request_trace as _rtrace
 # the registry itself lives in observability/health.py (no web-server
 # imports there — serving constructors register without paying for
 # http.server); re-exported here for the scrape-side callers
-from .health import (health_snapshot, register_health,  # noqa: F401
-                     unregister_health)
+from .health import (health_snapshot, providers,  # noqa: F401
+                     provider_snapshot, register_health,
+                     unregister_health, unregister_provider)
 
 __all__ = ["TelemetryServer", "start_server", "stop_server",
            "active_server", "register_health", "unregister_health",
@@ -68,22 +78,57 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _metrics_text(self, member):
+        """The /metrics payload. With a fleet-merged provider
+        registered (a FleetRouter lives here), the fleet view wins —
+        ``?member=`` drills into one member's raw snapshot (None =
+        unknown member, a 404). Providers whose owner is gone
+        unregister lazily and the local registry takes back over."""
+        for name, fn in sorted(providers("metrics").items()):
+            try:
+                text = fn(member)
+            except Exception:
+                continue
+            if text is None:
+                unregister_provider("metrics", name)
+                continue
+            if member and text == "":
+                return None  # provider alive, member unknown
+            return text
+        if member:
+            return None
+        return _metrics.REGISTRY.expose_text()
+
     def do_GET(self):
         try:
             url = urlparse(self.path)
+            qs = parse_qs(url.query)
             if url.path == "/metrics":
-                self._send(200, _metrics.REGISTRY.expose_text(),
-                           ctype="text/plain; version=0.0.4")
+                member = (qs.get("member") or [None])[0]
+                text = self._metrics_text(member)
+                if text is None:
+                    self._send(404, json.dumps(
+                        {"error": "unknown member %r" % member}))
+                else:
+                    self._send(200, text,
+                               ctype="text/plain; version=0.0.4")
             elif url.path == "/healthz":
                 snap = health_snapshot()
                 self._send(200 if snap["status"] == "ok" else 503,
                            json.dumps(snap, sort_keys=True))
             elif url.path == "/debug/trace":
-                qs = parse_qs(url.query)
                 tid = (qs.get("id") or [None])[0]
+                fmt = (qs.get("fmt") or [None])[0]
                 if tid is None:
                     self._send(200, json.dumps(
                         {"traces": _rtrace.trace_ids()}))
+                elif fmt == "chrome":
+                    doc = _rtrace.chrome_trace(tid)
+                    if doc is None:
+                        self._send(404, json.dumps(
+                            {"error": "unknown trace %r" % tid}))
+                    else:
+                        self._send(200, json.dumps(doc))
                 else:
                     tree = _rtrace.span_tree(tid)
                     if tree is None:
@@ -91,6 +136,26 @@ class _Handler(BaseHTTPRequestHandler):
                             {"error": "unknown trace %r" % tid}))
                     else:
                         self._send(200, json.dumps(tree))
+            elif url.path == "/debug/fleet":
+                docs = provider_snapshot("fleet")
+                if not docs:
+                    self._send(404, json.dumps(
+                        {"error": "no fleet router in this process"}))
+                elif len(docs) == 1:
+                    self._send(200, json.dumps(next(iter(
+                        docs.values()))))
+                else:
+                    self._send(200, json.dumps(docs))
+            elif url.path == "/debug/slo":
+                docs = provider_snapshot("slo")
+                if not docs:
+                    self._send(404, json.dumps(
+                        {"error": "no SLO tracker in this process"}))
+                elif len(docs) == 1:
+                    self._send(200, json.dumps(next(iter(
+                        docs.values()))))
+                else:
+                    self._send(200, json.dumps(docs))
             elif url.path == "/debug/flight":
                 bundle = _flight.RECORDER.latest()
                 if bundle is None:
@@ -102,7 +167,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(404, json.dumps(
                     {"error": "unknown path %r" % url.path,
                      "endpoints": ["/metrics", "/healthz",
-                                   "/debug/trace?id=", "/debug/flight"]}))
+                                   "/debug/trace?id=",
+                                   "/debug/fleet", "/debug/slo",
+                                   "/debug/flight"]}))
         except BrokenPipeError:
             pass
         except Exception as exc:

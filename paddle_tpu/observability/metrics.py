@@ -19,7 +19,7 @@ import threading
 
 __all__ = ["Registry", "Counter", "Gauge", "Histogram",
            "REGISTRY", "default_registry", "DEFAULT_TIME_BUCKETS",
-           "LATENCY_MS_BUCKETS"]
+           "LATENCY_MS_BUCKETS", "format_snapshot_text"]
 
 # Latency buckets in seconds: 500us .. 60s, wide enough for both a CPU
 # test step and a tunneled-H2D TPU step (PROFILE.md measures both).
@@ -365,64 +365,116 @@ class Registry:
             self.generation += 1
 
     # -- exposition ------------------------------------------------------
+    def snapshot(self):
+        """One CONSISTENT point-in-time copy of every family, taken
+        under a single hold of the registry lock (children share it, so
+        no recorder can move a value mid-walk):
+        ``[(name, kind, help, buckets, [(labels_dict, payload), ...])]``
+        sorted by name and label key. Payload is a float for
+        counters/gauges, ``(bucket_counts, count, sum, vmin, vmax)``
+        for histograms (raw per-bucket counts, NOT cumulative).
+
+        Formatting (``expose_text``/``dump``) and cross-process
+        shipping (``observability/aggregate.py``) both read THIS, then
+        work outside the lock — a scrape concurrent with labeled-child
+        creation can never render a half-updated family."""
+        with self._lock:
+            out = []
+            for name in sorted(self._families):
+                fam = self._families[name]
+                children = []
+                for key in sorted(fam._children):
+                    c = fam._children[key]
+                    if fam.kind == "histogram":
+                        payload = (list(c.bucket_counts), c.count,
+                                   c.sum, c.vmin, c.vmax)
+                    else:
+                        payload = c._value
+                    children.append((dict(c.labels_dict), payload))
+                out.append((name, fam.kind, fam.help, fam.buckets,
+                            children))
+            return out
+
+    @staticmethod
+    def _cumulative(buckets, bucket_counts):
+        """[(upper_bound, cumulative_count)] ending with (+Inf, total)
+        — the snapshot-payload analog of
+        :meth:`Histogram.cumulative_buckets`."""
+        out, running = [], 0
+        for ub, c in zip(buckets, bucket_counts):
+            running += c
+            out.append((ub, running))
+        out.append((math.inf, running + bucket_counts[-1]))
+        return out
+
     def expose_text(self):
-        """Prometheus text exposition format 0.0.4."""
-        lines = []
-        for name in sorted(self.families()):
-            fam = self._families[name]
-            children = fam.children()
-            if not children:
-                continue
-            if fam.help:
-                lines.append("# HELP %s %s" % (name, fam.help))
-            lines.append("# TYPE %s %s" % (name, fam.kind))
-            for key in sorted(children):
-                child = children[key]
-                labels = child.labels_dict
-                if fam.kind == "histogram":
-                    for ub, cum in child.cumulative_buckets():
-                        lines.append("%s_bucket%s %d" % (
-                            name, _label_suffix(labels,
-                                                {"le": _format_value(ub)}),
-                            cum))
-                    lines.append("%s_sum%s %s" % (
-                        name, _label_suffix(labels),
-                        repr(float(child.sum))))
-                    lines.append("%s_count%s %d" % (
-                        name, _label_suffix(labels), child.count))
-                else:
-                    lines.append("%s%s %s" % (
-                        name, _label_suffix(labels),
-                        _format_value(child.value)))
-        return "\n".join(lines) + "\n"
+        """Prometheus text exposition format 0.0.4 — formatted from
+        one consistent :meth:`snapshot`, outside the registry lock."""
+        return format_snapshot_text(self.snapshot())
 
     def dump(self):
-        """JSON-ready dict: {name: {type, help, samples: [...]}}."""
+        """JSON-ready dict: {name: {type, help, samples: [...]}} —
+        built from one consistent :meth:`snapshot`."""
         out = {}
-        for name, fam in sorted(self.families().items()):
+        for name, kind, help_text, buckets, children in self.snapshot():
             samples = []
-            children = fam.children()
-            for key in sorted(children):
-                child = children[key]
-                if fam.kind == "histogram":
+            for labels, payload in children:
+                if kind == "histogram":
+                    counts, count, vsum, vmin, vmax = payload
                     samples.append({
-                        "labels": child.labels_dict,
-                        "count": child.count,
-                        "sum": child.sum,
-                        "min": None if child.count == 0 else child.vmin,
-                        "max": None if child.count == 0 else child.vmax,
+                        "labels": labels,
+                        "count": count,
+                        "sum": vsum,
+                        "min": None if count == 0 else vmin,
+                        "max": None if count == 0 else vmax,
                         "buckets": {_format_value(ub): cum for ub, cum
-                                    in child.cumulative_buckets()},
+                                    in self._cumulative(buckets,
+                                                        counts)},
                     })
                 else:
-                    samples.append({"labels": child.labels_dict,
-                                    "value": child.value})
-            out[name] = {"type": fam.kind, "help": fam.help,
+                    samples.append({"labels": labels,
+                                    "value": payload})
+            out[name] = {"type": kind, "help": help_text,
                          "samples": samples}
         return out
 
     def dump_json(self, indent=None):
         return json.dumps(self.dump(), indent=indent, sort_keys=True)
+
+
+def format_snapshot_text(snap, help_texts=None):
+    """Prometheus text 0.0.4 from a :meth:`Registry.snapshot`-shaped
+    structure. ``help_texts`` optionally overrides/provides HELP lines
+    by family name (merged fleet views carry no help on the wire; the
+    scraping side fills in its own). Shared by ``Registry.expose_text``
+    and the fleet aggregator so a merged exposition is byte-identical
+    to a local one on local-only data."""
+    lines = []
+    for name, kind, help_text, buckets, children in snap:
+        if not children:
+            continue
+        if help_texts is not None and name in help_texts:
+            help_text = help_texts[name]
+        if help_text:
+            lines.append("# HELP %s %s" % (name, help_text))
+        lines.append("# TYPE %s %s" % (name, kind))
+        for labels, payload in children:
+            if kind == "histogram":
+                counts, count, vsum, _vmin, _vmax = payload
+                for ub, cum in Registry._cumulative(buckets, counts):
+                    lines.append("%s_bucket%s %d" % (
+                        name, _label_suffix(labels,
+                                            {"le": _format_value(ub)}),
+                        cum))
+                lines.append("%s_sum%s %s" % (
+                    name, _label_suffix(labels), repr(float(vsum))))
+                lines.append("%s_count%s %d" % (
+                    name, _label_suffix(labels), count))
+            else:
+                lines.append("%s%s %s" % (
+                    name, _label_suffix(labels),
+                    _format_value(payload)))
+    return "\n".join(lines) + "\n"
 
 
 REGISTRY = Registry()

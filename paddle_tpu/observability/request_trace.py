@@ -52,6 +52,7 @@ or SIGTERM.
 
 import collections
 import itertools
+import os
 import random
 import threading
 import time
@@ -62,7 +63,8 @@ from . import metrics as _metrics
 __all__ = ["TraceContext", "NO_TRACE", "mint", "adopt", "event",
            "global_event",
            "discard", "current", "activate", "trace_events", "span_tree",
-           "trace_ids", "enabled", "clear", "QUEUE_WAIT_MS",
+           "chrome_trace", "trace_ids", "enabled", "clear",
+           "QUEUE_WAIT_MS",
            "PREFILL_MS", "DECODE_STEP_MS", "REPLAY_RECOVERY_MS",
            "E2E_MS"]
 
@@ -366,6 +368,50 @@ class RequestTracer:
         return {"trace_id": trace_id, "dropped": self.dropped(trace_id),
                 "events": len(events), "root": root}
 
+    def chrome_trace(self, trace_id):
+        """One request trace as a Perfetto-loadable chrome-trace
+        document (``tracing.chrome_trace_doc`` wraps it): events with
+        a duration render as complete ("X") slices, point events as
+        instants ("i"). A cross-process fleet trace keys lanes by the
+        recording pid (the router's tree carries member pids in the
+        ack attrs), so router -> member -> replay peer reads as
+        separate tracks. None for an unknown trace."""
+        events = self.trace_events(trace_id)
+        if events is None:
+            return None
+        from . import tracing as _tracing
+        out = []
+        tids = {}
+        names = {}
+        for ev in events:
+            attrs = ev.get("attrs") or {}
+            pid = attrs.get("pid", os.getpid())
+            thread = ev.get("thread", 0)
+            tid = tids.setdefault((pid, thread), len(tids))
+            names.setdefault(
+                tid, "pid%s-t%s" % (pid, str(thread)[-4:]))
+            args = dict(attrs)
+            args["span_id"] = ev.get("span_id")
+            if ev.get("parent_id") is not None:
+                args["parent_id"] = ev["parent_id"]
+            ce = {"name": ev.get("name", "?"), "pid": pid,
+                  "tid": tid, "ts": float(ev["ts_ms"]) * 1e3,
+                  "cat": "request", "args": args}
+            dur = ev.get("dur_ms")
+            if dur is not None:
+                # a duration event closes AT ts: open the slice back
+                # at its start so the timeline reads causally
+                ce["ph"] = "X"
+                ce["dur"] = float(dur) * 1e3
+                ce["ts"] -= ce["dur"]
+            else:
+                ce["ph"] = "i"
+                ce["s"] = "t"
+            out.append(ce)
+        return _tracing.chrome_trace_doc(
+            out, process_name="paddle_tpu request %s" % trace_id,
+            thread_names=names)
+
 
 _TRACER = RequestTracer()
 
@@ -397,6 +443,10 @@ def trace_events(trace_id):
 
 def span_tree(trace_id):
     return _TRACER.span_tree(trace_id)
+
+
+def chrome_trace(trace_id):
+    return _TRACER.chrome_trace(trace_id)
 
 
 def trace_ids():

@@ -24,7 +24,8 @@ import threading
 import time
 
 __all__ = ["span", "instant", "start", "stop", "active", "clear",
-           "events", "emit_chrome_trace", "NULL_SPAN", "MAX_EVENTS"]
+           "events", "emit_chrome_trace", "chrome_trace_doc",
+           "NULL_SPAN", "MAX_EVENTS"]
 
 MAX_EVENTS = 200_000  # ring-buffer bound for always-on tracing
 
@@ -141,19 +142,30 @@ class Tracer:
     def emit_chrome_trace(self, path, ts_from=None, ts_to=None):
         """Write {"traceEvents": [...]} (Perfetto/chrome://tracing);
         optionally windowed to [ts_from, ts_to] on the trace clock."""
-        evs = self.events(ts_from, ts_to)
-        tids = {}
-        for ev in evs:
-            tids.setdefault(ev["tid"], ev["pid"])
-        meta = [{"ph": "M", "name": "process_name", "pid": os.getpid(),
-                 "tid": 0, "args": {"name": "paddle_tpu host"}}]
-        for tid, pid in sorted(tids.items()):
-            meta.append({"ph": "M", "name": "thread_name", "pid": pid,
-                         "tid": tid, "args": {"name": "host-%d" % tid}})
+        doc = chrome_trace_doc(self.events(ts_from, ts_to))
         with open(path, "w") as f:
-            json.dump({"traceEvents": meta + evs,
-                       "displayTimeUnit": "ms"}, f)
+            json.dump(doc, f)
         return path
+
+
+def chrome_trace_doc(evs, process_name="paddle_tpu host",
+                     thread_names=None):
+    """The chrome-trace document wrapper shared by the host-op tracer
+    and the request-trace exporter: prepends process/thread "M"
+    metadata to already-shaped trace events. ``thread_names`` maps
+    tid -> display name (default ``host-<tid>``)."""
+    tids = {}
+    for ev in evs:
+        tids.setdefault(ev.get("tid", 0), ev.get("pid", os.getpid()))
+    meta = [{"ph": "M", "name": "process_name", "pid": os.getpid(),
+             "tid": 0, "args": {"name": process_name}}]
+    for tid, pid in sorted(tids.items(),
+                           key=lambda kv: (isinstance(kv[0], str),
+                                           kv[0])):
+        name = (thread_names or {}).get(tid, "host-%s" % tid)
+        meta.append({"ph": "M", "name": "thread_name", "pid": pid,
+                     "tid": tid, "args": {"name": name}})
+    return {"traceEvents": meta + list(evs), "displayTimeUnit": "ms"}
 
 
 _TRACER = Tracer()

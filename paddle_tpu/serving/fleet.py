@@ -84,9 +84,11 @@ from concurrent.futures import Future
 import numpy as np
 
 from .. import config as _config
+from ..observability import aggregate as _aggregate
 from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 from ..observability import request_trace as _rtrace
+from ..observability import slo as _slo
 from ..resilience import faults as _faults
 from ..utils import log as _log
 from . import resilience as _sres
@@ -142,8 +144,14 @@ _RECOVERY_SECONDS = _metrics.REGISTRY.histogram(
     "paddle_fleet_recovery_seconds",
     "Member failure -> first replayed token streaming from a peer "
     "(kill-to-first-replayed-token)")
+_WORKER_DONE = _metrics.REGISTRY.counter(
+    "paddle_fleet_worker_done_total",
+    "Requests this member process completed (the per-member side of "
+    "the fleet conservation ledger: aggregated deltas must equal the "
+    "router-observed completions)")
 
 _ROUTER_SEQ = itertools.count()
+_WORKER_INCARNATION_SEQ = itertools.count()
 
 
 class _MemberError(RuntimeError):
@@ -240,7 +248,8 @@ class FleetRouter:
                  breaker_cooldown_ms=None, replay_attempts=3,
                  call_timeout=120.0, connect_timeout=5.0,
                  placement_timeout=30.0, canary_fraction=None,
-                 members_min=None):
+                 members_min=None, metrics_interval_ms=None,
+                 slo_target_p99_ms=None, slo_windows=None):
         self._rid = next(_ROUTER_SEQ)
         if heartbeat_timeout_ms is None:
             heartbeat_timeout_ms = \
@@ -264,6 +273,28 @@ class FleetRouter:
         if members_min is None:
             members_min = _config.get_flag("fleet_members_min")
         self.members_min = int(members_min)
+        if metrics_interval_ms is None:
+            metrics_interval_ms = _config.get_flag(
+                "fleet_metrics_interval_ms")
+        self.metrics_interval = float(metrics_interval_ms or 0.0) / 1e3
+        # the aggregator is pure ingest-side state (no threads, no
+        # sockets): always constructed, it only grows content when
+        # members actually ship snapshots
+        self._aggregator = _aggregate.FleetAggregator(
+            "f%d" % self._rid, interval_s=self.metrics_interval)
+        if slo_target_p99_ms is None:
+            slo_target_p99_ms = _config.get_flag("slo_target_p99_ms")
+        self.slo = None
+        if float(slo_target_p99_ms or 0.0) > 0:
+            # the router's SLO view is client-observed: its own
+            # submit->resolution histogram plus the shed/deadline
+            # counters (NOT the members' server-side latencies)
+            self.slo = _slo.SLOTracker(
+                label="f%d" % self._rid,
+                target_p99_ms=float(slo_target_p99_ms),
+                windows=slo_windows,
+                source=_slo.local_source(
+                    histogram="paddle_fleet_request_ms"))
         self._members = {}          # member id -> _Member
         self._generation = 0
         self._member_seq = itertools.count()
@@ -288,6 +319,20 @@ class FleetRouter:
         self._health_name = "fleet%d" % self._rid
         _health.register_health(self._health_name,
                                 _router_health(weakref.ref(self)))
+        # introspection surfaces (weakref-closed, like health): the
+        # merged /metrics view, the /debug/fleet document, the
+        # /debug/slo verdict, and the flight-recorder context so a
+        # breaker-open bundle carries the fleet state that triggered it
+        ref = weakref.ref(self)
+        _health.register_provider("metrics", self._health_name,
+                                  _router_metrics(ref))
+        _health.register_provider("fleet", self._health_name,
+                                  _router_fleet(ref))
+        if self.slo is not None:
+            _health.register_provider("slo", self._health_name,
+                                      _router_slo(ref))
+        _flight.RECORDER.add_context(self._health_name,
+                                     _router_flight_context(ref))
 
     # -- plumbing ---------------------------------------------------------
     @property
@@ -318,6 +363,45 @@ class FleetRouter:
         with self._lock:
             return {m.id: m.version for m in self._live_locked()}
 
+    def fleet_doc(self):
+        """The ``/debug/fleet`` document: membership, generation,
+        per-member breaker/load state, and telemetry snapshot ages in
+        one JSON-ready dict."""
+        with self._lock:
+            members = {}
+            for m in self._members.values():
+                members[m.id] = {
+                    "state": m.state,
+                    "version": m.version,
+                    "addr": list(m.addr),
+                    "joined_generation": m.joined_gen,
+                    "inflight": m.inflight,
+                    "served": m.served,
+                    "failures": m.failures,
+                    "breaker": None if m.breaker is None
+                    else m.breaker.state,
+                }
+            doc = {
+                "router": "f%d" % self._rid,
+                "generation": self._generation,
+                "live": len(self._live_locked()),
+                "members_min": self.members_min,
+                "canary": self._canary,
+                "closed": self._closed,
+                "members": members,
+            }
+        telemetry = self._aggregator.fleet_doc()
+        for mid, tstate in telemetry["members"].items():
+            members.setdefault(mid, {"state": "retired"})[
+                "telemetry"] = tstate
+        doc["telemetry"] = {k: v for k, v in telemetry.items()
+                            if k != "members"}
+        if self.slo is not None:
+            doc["slo"] = {"alerting": self.slo.alerting,
+                          "violation_seconds":
+                          round(self.slo.violation_seconds, 3)}
+        return doc
+
     def wait_members(self, n=None, timeout=30.0):
         """Block until ``n`` members (default ``members_min``) are in
         rotation — the bring-up rendezvous, fleet tier."""
@@ -342,6 +426,8 @@ class FleetRouter:
             conn.send(self._heartbeat(msg))
         elif cmd == "unreg":
             conn.send(self._unregister(msg))
+        elif cmd == "metrics":
+            conn.send(self._ingest_metrics(msg))
         elif cmd == "members":
             with self._lock:
                 conn.send({"ok": True, "generation": self._generation,
@@ -409,9 +495,42 @@ class FleetRouter:
             # the beat proves the process is alive; the fence only
             # says its world view is stale)
             m.deadline = time.monotonic() + self.heartbeat_timeout
-            if gen != self._generation:
-                return {"ok": False, "genmismatch": self._generation}
-            return {"ok": True, "generation": self._generation}
+            known = True
+            mismatch = gen != self._generation
+            generation = self._generation
+        # piggybacked registry snapshot: ingested outside the router
+        # lock (the aggregator has its own), and even on a fenced
+        # beat — a stale world view does not stale the numbers
+        snap = msg.get("metrics")
+        if known and snap is not None:
+            try:
+                self._aggregator.ingest(mid, msg.get("incarnation"),
+                                        snap)
+            except ValueError:
+                pass  # unreadable snapshot; the beat itself counted
+        if mismatch:
+            return {"ok": False, "genmismatch": generation}
+        return {"ok": True, "generation": generation}
+
+    def _ingest_metrics(self, msg):
+        """The standalone ``metrics`` verb: an out-of-band snapshot
+        push (a closing worker's final ship, probes, tests)."""
+        mid = str(msg.get("member"))
+        with self._lock:
+            m = self._members.get(mid)
+            if m is None:
+                return {"ok": False,
+                        "error": "unknown member %r" % mid}
+        try:
+            merged = self._aggregator.ingest(
+                mid, msg.get("incarnation"), msg.get("snapshot"))
+        except ValueError as exc:
+            return {"ok": False, "error": repr(exc)[:200]}
+        if m.state == "dead":
+            # a final ship from an already-dropped member: the counts
+            # land (conservation), the staleness clock stays running
+            self._aggregator.mark_dead(mid)
+        return {"ok": True, "families": merged}
 
     def _unregister(self, msg):
         mid = str(msg.get("member"))
@@ -421,6 +540,11 @@ class FleetRouter:
     def _monitor_loop(self):
         tick = min(0.5, max(0.01, self.heartbeat_timeout / 4.0))
         while not self._monitor_stop.wait(tick):
+            if self.slo is not None:
+                # the tracker is pull-based; the membership monitor is
+                # its clock (verdict() also ticks, so a pull-only
+                # router without a monitor thread still works)
+                self.slo.tick()
             now = time.monotonic()
             with self._lock:
                 overdue = [m.id for m in self._members.values()
@@ -448,6 +572,9 @@ class FleetRouter:
             self._gauge("live").set(live)
         if death:
             _DEATHS.inc()
+        # telemetry: its snapshot stays, staleness-labeled, for a
+        # bounded number of windows (conservation already banked)
+        self._aggregator.mark_dead(mid)
         if m.breaker is not None:
             m.breaker.retired = True  # no gauge resurrection
         # stale-label hygiene: every family labelled on this member —
@@ -1036,8 +1163,13 @@ class FleetRouter:
         _metrics.REGISTRY.remove_labeled("member", prefix=prefix)
         _metrics.REGISTRY.remove_labeled("router",
                                          value="f%d" % self._rid)
+        if self.slo is not None:
+            self.slo.close()
         from ..observability import health as _health
         _health.unregister_health(self._health_name)
+        for kind in ("metrics", "fleet", "slo"):
+            _health.unregister_provider(kind, self._health_name)
+        _flight.RECORDER.remove_context(self._health_name)
 
     def __enter__(self):
         return self
@@ -1070,6 +1202,52 @@ def _router_health(ref):
     return snapshot
 
 
+def _router_metrics(ref):
+    """The /metrics provider: the fleet-merged exposition (or one
+    member's drill-down; "" for an unknown member — None is reserved
+    for "router gone", the lazy-unregister signal)."""
+    def provider(member=None):
+        router = ref()
+        if router is None:
+            return None
+        text = router._aggregator.merged_text(member)
+        if member and text is None:
+            return ""
+        return text
+    return provider
+
+
+def _router_fleet(ref):
+    def provider():
+        router = ref()
+        return None if router is None else router.fleet_doc()
+    return provider
+
+
+def _router_slo(ref):
+    def provider():
+        router = ref()
+        if router is None or router.slo is None:
+            return None
+        return router.slo.verdict()
+    return provider
+
+
+def _router_flight_context(ref):
+    """Flight-recorder context: a breaker-open / client-error bundle
+    dumped at the router carries the fleet membership + SLO state
+    that surrounded it."""
+    def context():
+        router = ref()
+        if router is None:
+            return None
+        doc = {"fleet": router.fleet_doc()}
+        if router.slo is not None:
+            doc["slo"] = router.slo.verdict()
+        return doc
+    return context
+
+
 class EngineWorker:
     """One fleet member: serves a local backend over the JSON-line
     wire and keeps its membership lease with the router.
@@ -1091,7 +1269,7 @@ class EngineWorker:
     def __init__(self, backend, host="127.0.0.1", port=0,
                  member_id=None, router_addr=None, heartbeat_ms=None,
                  version="v0", fail_after_swap_tag=None,
-                 autostart=True):
+                 autostart=True, metrics_interval_ms=None):
         self.backend = backend
         self._kind = ("generation" if hasattr(backend, "sessions")
                       else "engine")
@@ -1106,6 +1284,16 @@ class EngineWorker:
         if heartbeat_ms is None:
             heartbeat_ms = _config.get_flag("fleet_heartbeat_ms")
         self.heartbeat = float(heartbeat_ms) / 1e3
+        if metrics_interval_ms is None:
+            metrics_interval_ms = _config.get_flag(
+                "fleet_metrics_interval_ms")
+        self.metrics_interval = float(metrics_interval_ms or 0.0) / 1e3
+        # the delta-accounting identity: a restarted process carries a
+        # fresh incarnation, so its zeroed totals re-base instead of
+        # double-counting or regressing the fleet accumulators
+        self.incarnation = "%d-%d" % (os.getpid(),
+                                      next(_WORKER_INCARNATION_SEQ))
+        self._next_ship = 0.0      # monotonic; 0 = first beat ships
         self.version = str(version)
         self.fail_after_swap_tag = fail_after_swap_tag
         self._prev = None          # (version, params/model_dir) snapshot
@@ -1166,12 +1354,21 @@ class EngineWorker:
             if _faults.should_fire("fleet_network_partition",
                                    self.member_id):
                 continue  # injected partition: the beat never leaves
+            msg = {"cmd": "hb", "member": self.member_id,
+                   "generation": self.generation}
+            if self.metrics_interval > 0:
+                now = time.monotonic()
+                if now >= self._next_ship:
+                    # piggyback a registry snapshot, bounded so the
+                    # frame NEVER breaches MAX_LINE — an oversize
+                    # registry degrades the snapshot, not the beat
+                    msg["metrics"] = _aggregate.build_snapshot(
+                        max_bytes=_wire.MAX_LINE - 1024)
+                    msg["incarnation"] = self.incarnation
+                    self._next_ship = now + self.metrics_interval
             try:
-                rep = _wire.call_once(
-                    self.router_addr,
-                    {"cmd": "hb", "member": self.member_id,
-                     "generation": self.generation},
-                    timeout=2.0, retries=1)
+                rep = _wire.call_once(self.router_addr, msg,
+                                      timeout=2.0, retries=1)
             except (ConnectionError, OSError, _wire.WireError):
                 continue  # router restarting/unreachable: keep beating
             if rep.get("ok"):
@@ -1286,6 +1483,7 @@ class EngineWorker:
             except OSError:
                 pass
             return
+        _WORKER_DONE.inc()  # this member's side of the ledger
         try:
             conn.send({"ev": "done", "tokens": tokens,
                        "member": self.member_id,
@@ -1308,6 +1506,7 @@ class EngineWorker:
                     for name, spec in msg["feed"].items()}
             outs = self.backend.run(
                 feed, deadline_ms=msg.get("deadline_ms"))
+            _WORKER_DONE.inc()
             conn.send({"ev": "done", "member": self.member_id,
                        "version": self.version,
                        "outputs": [{"data": np.asarray(o).tolist(),
@@ -1401,6 +1600,19 @@ class EngineWorker:
             self._hb_thread.join(timeout=2.0)
             self._hb_thread = None
         if self.router_addr is not None:
+            if self.metrics_interval > 0:
+                # final ship: the counts earned since the last beat
+                # land before the membership lease is surrendered
+                try:
+                    _wire.call_once(
+                        self.router_addr,
+                        {"cmd": "metrics", "member": self.member_id,
+                         "incarnation": self.incarnation,
+                         "snapshot": _aggregate.build_snapshot(
+                             max_bytes=_wire.MAX_LINE - 1024)},
+                        timeout=2.0, retries=1)
+                except (ConnectionError, OSError, _wire.WireError):
+                    pass
             try:
                 _wire.call_once(self.router_addr,
                                 {"cmd": "unreg",

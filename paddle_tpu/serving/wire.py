@@ -32,8 +32,8 @@ import socket
 import threading
 import time
 
-__all__ = ["WireError", "MAX_LINE", "send_msg", "LineConn",
-           "LineServer", "call_once", "retry_delay"]
+__all__ = ["WireError", "MAX_LINE", "send_msg", "encoded_size",
+           "LineConn", "LineServer", "call_once", "retry_delay"]
 
 # One framed message may carry a whole replay journal (prompt plus
 # every generated token as JSON ints) or a packed feed — 8 MiB bounds
@@ -54,6 +54,13 @@ def send_msg(sock, obj):
         raise WireError("message of %d bytes exceeds the %d-byte "
                         "frame cap" % (len(data), MAX_LINE))
     sock.sendall(data)
+
+
+def encoded_size(obj):
+    """The exact on-wire byte count ``send_msg`` would frame ``obj``
+    as (newline included) — how snapshot shippers budget against
+    :data:`MAX_LINE` without paying a throwaway send."""
+    return len(json.dumps(obj, separators=(",", ":")).encode()) + 1
 
 
 def retry_delay(attempt, backoff=0.05, cap=2.0):

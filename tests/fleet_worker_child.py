@@ -121,6 +121,7 @@ def main():
     ap.add_argument("--fail-after-swap", default=None)
     ap.add_argument("--compile-cache", default=None)
     ap.add_argument("--heartbeat-ms", type=float, default=None)
+    ap.add_argument("--metrics-interval-ms", type=float, default=None)
     ap.add_argument("--version", default="v0")
     args = ap.parse_args()
 
@@ -144,7 +145,8 @@ def main():
     worker = EngineWorker(
         sched, member_id=args.member, router_addr=(host, int(port)),
         heartbeat_ms=args.heartbeat_ms, version=args.version,
-        fail_after_swap_tag=args.fail_after_swap)
+        fail_after_swap_tag=args.fail_after_swap,
+        metrics_interval_ms=args.metrics_interval_ms)
     print("READY %s %d" % (args.member, worker.addr[1]), flush=True)
     try:
         worker.serve_forever()

@@ -740,13 +740,19 @@ class TestDefaultsOffHotPath:
         fm = FakeMember()
         try:
             fm.register(router, "m0")
-            assert [c for c in calls if c.startswith("fleet_")] == \
-                ["fleet_canary_fraction", "fleet_members_min"]
+            assert [c for c in calls
+                    if c.startswith(("fleet_", "slo_"))] == \
+                ["fleet_canary_fraction", "fleet_members_min",
+                 "fleet_metrics_interval_ms", "slo_target_p99_ms"]
+            # the windows flag is gated behind a nonzero SLO target:
+            # defaults never touch it
+            assert "slo_windows" not in calls
             calls.clear()
             out = router.submit([3], max_new_tokens=3,
                                 meta=True).result(timeout=10)
             assert len(out["tokens"]) == 3
-            assert not [c for c in calls if c.startswith("fleet_")]
+            assert not [c for c in calls
+                        if c.startswith(("fleet_", "slo_"))]
         finally:
             router.close()
             fm.close()
@@ -764,7 +770,10 @@ class TestDefaultsOffHotPath:
         # happens in the constructor, nowhere else
         worker = EngineWorker(object(), autostart=False)
         assert calls.count("fleet_heartbeat_ms") == 1
+        assert calls.count("fleet_metrics_interval_ms") == 1
         assert worker.heartbeat == orig("fleet_heartbeat_ms") / 1e3
+        assert worker.metrics_interval == \
+            orig("fleet_metrics_interval_ms") / 1e3
         router = FleetRouter(heartbeat_timeout_ms=None)
         try:
             assert router.heartbeat_timeout == \
@@ -812,15 +821,21 @@ class TestFleetChaosSubprocess:
         sched.close()
 
         deaths0 = counter("paddle_fleet_member_deaths_total")
+        # telemetry plane rides along: members ship snapshots every
+        # 100ms; the router-side window is deliberately long (30s) so
+        # the dead member's retained-but-stale snapshot is still
+        # observable when we assert on it
         router = FleetRouter(heartbeat_timeout_ms=700,
                              replay_attempts=6, breaker_failures=2,
-                             breaker_cooldown_ms=60000.0)
+                             breaker_cooldown_ms=60000.0,
+                             metrics_interval_ms=30000.0)
+        ship = ("--metrics-interval-ms", "100")
         procs = []
         try:
             procs.append(_spawn_child(router, "m0",
-                                      "--kill-at-token", "4"))
-            procs.append(_spawn_child(router, "m1"))
-            procs.append(_spawn_child(router, "m2"))
+                                      "--kill-at-token", "4", *ship))
+            procs.append(_spawn_child(router, "m1", *ship))
+            procs.append(_spawn_child(router, "m2", *ship))
             router.wait_members(3, timeout=120)
             futs = [router.submit(p, max_new_tokens=12, eos_id=-1,
                                   meta=True) for p in prompts]
@@ -848,6 +863,52 @@ class TestFleetChaosSubprocess:
             assert "m0" not in router.members_live()
             assert counter("paddle_fleet_member_deaths_total") >= \
                 deaths0 + 1
+
+            # -- telemetry conservation across the kill ------------
+            # every completed request incremented exactly one
+            # member's done counter; m0 died before completing any
+            # (the kill fires at streamed token 4 of 12), so the
+            # fleet-aggregated total must converge on EXACTLY the
+            # request count — nothing lost, nothing double-counted
+            def _fleet_done():
+                return router._aggregator.counter_value(
+                    "paddle_fleet_worker_done_total")
+            expected = float(len(prompts))
+            deadline = time.monotonic() + 30
+            while _fleet_done() < expected and \
+                    time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert _fleet_done() == expected, \
+                "fleet done %.0f != %d completed requests" \
+                % (_fleet_done(), len(prompts))
+            # the dead member's snapshot is retained but flagged
+            doc = router.fleet_doc()
+            assert doc["members"]["m0"]["state"] == "dead"
+            tele = doc["members"]["m0"].get("telemetry")
+            assert tele is not None and tele["ingests"] >= 1
+            assert tele["dead"] is True and tele["stale"] is True
+            assert doc["members"]["m1"]["telemetry"]["stale"] is False
+
+            # -- restart: same id, new incarnation -----------------
+            # the respawned m0 reports fresh small totals under a new
+            # (member, incarnation) key: they fold in whole — the
+            # no-double-count side of the conservation ledger
+            procs.append(_spawn_child(router, "m0", *ship))
+            router.wait_members(3, timeout=120)
+            futs = [router.submit(p, max_new_tokens=6, eos_id=-1)
+                    for p in prompts[:6]]
+            for f in futs:
+                f.result(timeout=300)
+            expected += 6
+            deadline = time.monotonic() + 30
+            while _fleet_done() < expected and \
+                    time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert _fleet_done() == expected
+            # let a few more ships land: the total must HOLD (re-
+            # delivered snapshots are idempotent, no drift)
+            time.sleep(0.5)
+            assert _fleet_done() == expected
         finally:
             router.close()
             _stop_children(procs)

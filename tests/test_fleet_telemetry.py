@@ -1,0 +1,716 @@
+"""Fleet telemetry plane: mergeable snapshots with (member,
+incarnation) delta accounting, the router-side aggregator and its
+introspection surfaces, SLO burn-rate tracking, and the exposition
+atomicity fix.
+
+The conservation proofs run in-process with explicit snapshot pushes
+(deterministic restarts/incarnation bumps); the real wire path runs a
+FleetRouter against an in-process EngineWorker over a fake backend.
+The subprocess SIGKILL variant rides the slow chaos suite in
+test_fleet.py.
+"""
+
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import Future
+
+import pytest
+
+import paddle_tpu as ptpu
+from paddle_tpu.observability import aggregate, flight
+from paddle_tpu.observability import http as ohttp
+from paddle_tpu.observability import metrics
+from paddle_tpu.observability import request_trace as rtrace
+from paddle_tpu.observability import slo
+from paddle_tpu.serving import wire
+from paddle_tpu.serving.fleet import EngineWorker, FleetRouter
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    yield
+    ptpu.config.set_flags(request_tracing=False, trace_sample_rate=1.0,
+                          telemetry_port=0, flight_dir=None)
+
+
+def _reg():
+    return metrics.Registry()
+
+
+def _get(url, expect=200):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as err:
+        assert err.code == expect, (err.code, expect)
+        return err.code, err.read().decode()
+
+
+# -- snapshot encoding -----------------------------------------------------
+
+class TestSnapshot:
+    def test_roundtrip_shape(self):
+        reg = _reg()
+        reg.counter("paddle_t_total", "c").inc(3)
+        reg.gauge("paddle_t_gauge", "g",
+                  labelnames=("x",)).labels(x="a").set(2.5)
+        h = reg.histogram("paddle_t_ms", "h",
+                          buckets=metrics.LATENCY_MS_BUCKETS)
+        h.observe(5.0)
+        snap = aggregate.snapshot_registry(reg)
+        # JSON-clean and versioned
+        decoded = json.loads(aggregate.encode_snapshot(snap))
+        assert decoded["v"] == aggregate.SNAPSHOT_VERSION
+        fams = decoded["fams"]
+        assert fams["paddle_t_total"]["k"] == "counter"
+        assert fams["paddle_t_total"]["ch"] == [[[], 3.0]]
+        assert fams["paddle_t_gauge"]["ln"] == ["x"]
+        hist = fams["paddle_t_ms"]
+        assert hist["b"] == list(metrics.LATENCY_MS_BUCKETS)
+        counts, count, vsum, vmin, vmax = hist["ch"][0][1]
+        assert count == 1 and vsum == 5.0 and vmin == 5.0
+        assert sum(counts) == 1
+        assert len(counts) == len(hist["b"]) + 1
+
+    def test_empty_histogram_minmax_is_json_clean(self):
+        reg = _reg()
+        reg.histogram("paddle_t_ms", "h").labels()
+        snap = aggregate.snapshot_registry(reg)
+        _counts, count, _s, vmin, vmax = \
+            snap["fams"]["paddle_t_ms"]["ch"][0][1]
+        assert count == 0 and vmin is None and vmax is None
+        json.dumps(snap)  # no inf leaks
+
+    def test_cardinality_cap_worst_case_fits_max_line(self):
+        """Satellite: at the registry's own cardinality cap with fat
+        label values, the snapshot plus heartbeat envelope stays
+        under the wire frame cap without degradation."""
+        reg = _reg()
+        fam = reg.histogram("paddle_t_worstcase_ms", "worst case",
+                            labelnames=("member",),
+                            buckets=metrics.LATENCY_MS_BUCKETS)
+        for i in range(metrics.DEFAULT_LABEL_CARDINALITY_CAP):
+            fam.labels(member="f0:member-%04d-%s" % (i, "x" * 48)) \
+                .observe(float(i % 60))
+        snap = aggregate.build_snapshot(
+            max_bytes=wire.MAX_LINE - 1024, registry=reg)
+        assert "truncated" not in snap
+        hb = {"cmd": "hb", "member": "m0", "generation": 3,
+              "incarnation": "1234-1", "metrics": snap}
+        assert wire.encoded_size(hb) <= wire.MAX_LINE
+
+    def test_oversize_degrades_histograms_first_counters_last(self):
+        reg = _reg()
+        h = reg.histogram("paddle_t_big_ms", "big hist",
+                          labelnames=("k",),
+                          buckets=metrics.LATENCY_MS_BUCKETS)
+        for i in range(50):
+            h.labels(k="key-%03d" % i).observe(1.0)
+        reg.counter("paddle_t_kept_total", "small counter").inc(7)
+        before = sum(
+            payload for n, _k, _h, _b, ch
+            in metrics.REGISTRY.snapshot()
+            if n == "paddle_fleet_snapshot_truncated_total"
+            for _l, payload in ch)
+        full = aggregate.encoded_size(aggregate.snapshot_registry(reg))
+        budget = full // 2
+        snap = aggregate.build_snapshot(max_bytes=budget, registry=reg)
+        assert aggregate.encoded_size(snap) <= budget
+        assert snap.get("truncated", 0) >= 1
+        # the conservation-critical counter survives the squeeze
+        assert "paddle_t_kept_total" in snap["fams"]
+        assert "paddle_t_big_ms" not in snap["fams"]
+        after = sum(
+            payload for n, _k, _h, _b, ch
+            in metrics.REGISTRY.snapshot()
+            if n == "paddle_fleet_snapshot_truncated_total"
+            for _l, payload in ch)
+        assert after >= before + 1
+
+    def test_degenerate_budget_yields_summary_frame(self):
+        reg = _reg()
+        reg.counter("paddle_t_total", "c").inc()
+        snap = aggregate.build_snapshot(max_bytes=40, registry=reg)
+        assert snap["fams"] == {}
+        assert snap["truncated"] >= 1
+        assert aggregate.encoded_size(snap) <= 40
+
+
+# -- delta accounting ------------------------------------------------------
+
+def _snap(reg):
+    return aggregate.snapshot_registry(reg)
+
+
+class TestDeltaAccounting:
+    def test_counter_conservation_across_restart(self):
+        """The acceptance identity: monotonic totals fold in as
+        deltas; an incarnation bump re-bases at zero, so a restart
+        neither double-counts nor regresses the fleet total."""
+        local = _reg()
+        agg = aggregate.FleetAggregator("f0", interval_s=1.0,
+                                        registry=local)
+        worker = _reg()
+        c = worker.counter("paddle_t_req_total", "reqs")
+        c.inc(5)
+        agg.ingest("m0", "inc1", _snap(worker))
+        assert agg.counter_value("paddle_t_req_total") == 5.0
+        # same incarnation, re-delivered: idempotent
+        agg.ingest("m0", "inc1", _snap(worker))
+        assert agg.counter_value("paddle_t_req_total") == 5.0
+        c.inc(3)
+        agg.ingest("m0", "inc1", _snap(worker))
+        assert agg.counter_value("paddle_t_req_total") == 8.0
+        # restart: a fresh process reports small totals under a new
+        # incarnation — counted whole, nothing double-counted
+        worker2 = _reg()
+        worker2.counter("paddle_t_req_total", "reqs").inc(2)
+        agg.ingest("m0", "inc2", _snap(worker2))
+        assert agg.counter_value("paddle_t_req_total") == 10.0
+        # a regressed total under the SAME incarnation never
+        # subtracts — it re-bases
+        worker3 = _reg()
+        worker3.counter("paddle_t_req_total", "reqs").inc(1)
+        agg.ingest("m0", "inc2", _snap(worker3))
+        assert agg.counter_value("paddle_t_req_total") == 10.0
+
+    def test_multi_member_sum(self):
+        agg = aggregate.FleetAggregator("f0", registry=_reg())
+        for mid, n in (("m0", 4), ("m1", 7), ("m2", 1)):
+            w = _reg()
+            w.counter("paddle_t_req_total", "reqs").inc(n)
+            agg.ingest(mid, "i-%s" % mid, _snap(w))
+        assert agg.counter_value("paddle_t_req_total") == 12.0
+
+    def test_histogram_bucketwise_merge(self):
+        local = _reg()
+        agg = aggregate.FleetAggregator("f0", registry=local)
+        lh = local.histogram("paddle_t_ms", "h",
+                             buckets=metrics.LATENCY_MS_BUCKETS)
+        lh.observe(3.0)
+        w = _reg()
+        wh = w.histogram("paddle_t_ms", "h",
+                         buckets=metrics.LATENCY_MS_BUCKETS)
+        wh.observe(3.0)
+        wh.observe(700.0)
+        agg.ingest("m0", "i1", _snap(w))
+        wh.observe(700.0)
+        agg.ingest("m0", "i1", _snap(w))
+        merged = {n: ch for n, _k, _h, _b, ch
+                  in agg.merged_snapshot()}
+        (_labels, (counts, count, vsum, vmin, vmax)), = \
+            [c for c in merged["paddle_t_ms"]]
+        assert count == 4  # 1 local + 3 member observations
+        assert vsum == pytest.approx(3.0 + 3.0 + 700.0 + 700.0)
+        assert vmin == 3.0 and vmax == 700.0
+        assert sum(counts) == 4
+        # exposition renders cumulative buckets + count == sum line
+        text = agg.merged_text()
+        assert 'paddle_t_ms_bucket{le="+Inf"} 4' in text
+        assert "paddle_t_ms_count 4" in text
+
+    def test_gauge_relabel_staleness_and_retirement(self):
+        local = _reg()
+        agg = aggregate.FleetAggregator("f7", interval_s=1.0,
+                                        retain_windows=3,
+                                        registry=local)
+        w = _reg()
+        w.gauge("paddle_t_depth", "depth").labels().set(4.0)
+        w.counter("paddle_t_req_total", "reqs").inc(9)
+        agg.ingest("m0", "i1", _snap(w), now=100.0)
+        text = metrics.format_snapshot_text(
+            agg.merged_snapshot(now=100.5))
+        assert 'paddle_t_depth{member="f7:m0"} 4' in text
+        assert "stale" not in text
+        # silence past 2 windows: staleness-labeled, value retained
+        text = metrics.format_snapshot_text(
+            agg.merged_snapshot(now=102.5))
+        assert 'member="f7:m0"' in text and 'stale="1"' in text
+        # death: stays stale-labeled within the retention horizon...
+        agg.mark_dead("m0")
+        doc = agg.fleet_doc(now=101.0)
+        assert doc["members"]["m0"]["dead"] is True
+        assert doc["members"]["m0"]["stale"] is True
+        # ...then the snapshot retires; the accumulated counters do NOT
+        with agg._lock:
+            agg._members["m0"].dead_t = 100.0  # deterministic clock
+        text = metrics.format_snapshot_text(
+            agg.merged_snapshot(now=104.1))  # > 3 windows after death
+        assert "paddle_t_depth" not in text
+        assert agg.counter_value("paddle_t_req_total") == 9.0
+        assert "m0" not in agg.fleet_doc(now=104.2)["members"]
+
+    def test_member_label_collision_uses_origin(self):
+        agg = aggregate.FleetAggregator("f0", registry=_reg())
+        w = _reg()
+        w.gauge("paddle_t_inflight", "g", labelnames=("member",)) \
+            .labels(member="x").set(1.0)
+        agg.ingest("m0", "i1", _snap(w))
+        text = agg.merged_text()
+        assert 'origin="f0:m0"' in text
+
+    def test_merged_text_untouched_is_byte_identical(self):
+        agg = aggregate.FleetAggregator("f0")
+        assert agg.merged_text() == metrics.REGISTRY.expose_text()
+
+    def test_member_drilldown(self):
+        agg = aggregate.FleetAggregator("f3", registry=_reg())
+        w = _reg()
+        w.counter("paddle_t_req_total", "reqs").inc(2)
+        agg.ingest("m1", "i1", _snap(w))
+        text = agg.merged_text(member="m1")
+        assert "paddle_t_req_total 2" in text
+        # the f<rid>:<mid> spelling drills down too
+        assert agg.merged_text(member="f3:m1") == text
+        assert agg.merged_text(member="nope") is None
+
+    def test_version_mismatch_rejected(self):
+        agg = aggregate.FleetAggregator("f0", registry=_reg())
+        with pytest.raises(ValueError):
+            agg.ingest("m0", "i1", {"v": 999, "fams": {}})
+        with pytest.raises(ValueError):
+            agg.ingest("m0", "i1", ["not", "a", "snapshot"])
+
+
+# -- exposition atomicity (satellite) --------------------------------------
+
+class TestExposeAtomicity:
+    def test_scrape_is_one_consistent_snapshot(self):
+        """Regression: a scrape concurrent with observations must
+        render each histogram child internally consistent — the +Inf
+        cumulative bucket, the _count line, and raw-count sums agree
+        within one exposition (one snapshot under the registry lock,
+        formatted outside it)."""
+        reg = _reg()
+        h = reg.histogram("paddle_t_race_ms", "h",
+                          labelnames=("k",),
+                          buckets=metrics.LATENCY_MS_BUCKETS)
+        stop = threading.Event()
+
+        def mutate():
+            i = 0
+            while not stop.is_set():
+                h.labels(k="k%d" % (i % 17)).observe(float(i % 90))
+                i += 1
+
+        threads = [threading.Thread(target=mutate) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(60):
+                text = reg.expose_text()
+                inf = {}
+                counts = {}
+                for line in text.splitlines():
+                    if line.startswith("paddle_t_race_ms_bucket") \
+                            and 'le="+Inf"' in line:
+                        key = line.split("k=")[1].split('"')[1]
+                        inf[key] = float(line.rsplit(" ", 1)[1])
+                    elif line.startswith("paddle_t_race_ms_count"):
+                        key = line.split("k=")[1].split('"')[1]
+                        counts[key] = float(line.rsplit(" ", 1)[1])
+                assert inf == counts, "torn scrape"
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+
+    def test_dump_matches_expose(self):
+        reg = _reg()
+        reg.counter("paddle_t_total", "c").inc(2)
+        d = reg.dump()
+        assert d["paddle_t_total"]["samples"][0]["value"] == 2.0
+        assert "paddle_t_total 2" in reg.expose_text()
+
+
+# -- the wire path (real router + in-process worker) -----------------------
+
+class _Spec:
+    eos_id = 1
+
+
+class _Session:
+    spec = _Spec()
+
+
+class FakeBackend:
+    """Quacks like a GenerationScheduler: submit -> Future, token
+    callback, deterministic output."""
+
+    def __init__(self, delay=0.0):
+        self.sessions = [_Session()]
+        self.delay = delay
+
+    def submit(self, prompt, max_new_tokens=None, eos_id=None,
+               deadline_ms=None, on_token=None):
+        fut = Future()
+        if self.delay:
+            time.sleep(self.delay)
+        toks = [int(p) % 7 + 2 for p in list(prompt)[:max_new_tokens
+                                                     or 2]] or [3]
+        for t in toks:
+            if on_token is not None:
+                on_token(t)
+        fut.set_result(toks)
+        return fut
+
+
+class TestWireShipping:
+    def test_heartbeat_piggyback_and_conservation(self):
+        router = FleetRouter(heartbeat_timeout_ms=2000,
+                             metrics_interval_ms=60,
+                             replay_attempts=2)
+        worker = None
+        try:
+            worker = EngineWorker(FakeBackend(), member_id="w0",
+                                  router_addr=router.addr,
+                                  heartbeat_ms=50,
+                                  metrics_interval_ms=60)
+            n = 5
+            futs = [router.submit([3, 4], max_new_tokens=2)
+                    for _ in range(n)]
+            for f in futs:
+                f.result(timeout=30)
+            # worker and test share one process, so the shipped total
+            # is the process-global counter — conservation means the
+            # fresh aggregator converges on exactly that value
+            expected = sum(
+                payload for name, _k, _h, _b, ch
+                in metrics.REGISTRY.snapshot()
+                if name == "paddle_fleet_worker_done_total"
+                for _l, payload in ch)
+            assert expected >= n
+            deadline = time.monotonic() + 15
+            got = 0.0
+            while time.monotonic() < deadline:
+                got = router._aggregator.counter_value(
+                    "paddle_fleet_worker_done_total")
+                if got >= expected:
+                    break
+                time.sleep(0.05)
+            assert got == expected, "aggregated %.0f != %.0f done" \
+                % (got, expected)
+            doc = router.fleet_doc()
+            assert doc["members"]["w0"]["telemetry"]["ingests"] >= 1
+            assert doc["members"]["w0"]["telemetry"]["stale"] is False
+            # merged exposition carries the member's counters
+            text = router._aggregator.merged_text()
+            assert "paddle_fleet_worker_done_total" in text
+        finally:
+            if worker is not None:
+                worker.close()
+            router.close()
+
+    def test_defaults_ship_nothing(self):
+        """Byte-identical defaults: interval 0 puts no metrics key on
+        any heartbeat and the aggregator stays untouched."""
+        router = FleetRouter(heartbeat_timeout_ms=2000)
+        seen = []
+        orig = router._heartbeat
+
+        def spy(msg):
+            seen.append(sorted(msg))
+            return orig(msg)
+        router._heartbeat = spy
+        worker = None
+        try:
+            assert router.metrics_interval == 0.0
+            assert router.slo is None
+            worker = EngineWorker(FakeBackend(), member_id="w0",
+                                  router_addr=router.addr,
+                                  heartbeat_ms=30)
+            deadline = time.monotonic() + 10
+            while len(seen) < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert len(seen) >= 3
+            assert all("metrics" not in keys for keys in seen)
+            assert router._aggregator.merged_text() == \
+                metrics.REGISTRY.expose_text()
+        finally:
+            if worker is not None:
+                worker.close()
+            router.close()
+
+    def test_metrics_verb_and_final_ship(self):
+        router = FleetRouter(heartbeat_timeout_ms=0,
+                             metrics_interval_ms=100000)
+        worker = None
+        try:
+            worker = EngineWorker(FakeBackend(), member_id="w1",
+                                  router_addr=router.addr,
+                                  heartbeat_ms=10000,
+                                  metrics_interval_ms=100000)
+            # unknown members are rejected outright
+            rep = wire.call_once(
+                router.addr,
+                {"cmd": "metrics", "member": "ghost",
+                 "incarnation": "x",
+                 "snapshot": {"v": 1, "fams": {}}})
+            assert not rep["ok"]
+            assert router._aggregator.counter_value(
+                "paddle_fleet_worker_done_total") == 0.0
+            router.submit([5], max_new_tokens=1).result(timeout=30)
+            # the worker and this test share one process, so the ship
+            # carries the process-global done total — the tail the
+            # final ship must land even though the interval has NOT
+            # elapsed
+            expected = sum(
+                payload for n, _k, _h, _b, ch
+                in metrics.REGISTRY.snapshot()
+                if n == "paddle_fleet_worker_done_total"
+                for _l, payload in ch)
+            assert expected >= 1.0
+            worker.close()
+            worker = None
+            got = router._aggregator.counter_value(
+                "paddle_fleet_worker_done_total")
+            assert got == expected
+        finally:
+            if worker is not None:
+                worker.close()
+            router.close()
+
+
+# -- SLO tracking ----------------------------------------------------------
+
+class TestSLOTracker:
+    def test_percentiles_and_burn_windows(self):
+        reg = _reg()
+        h = reg.histogram("paddle_t_e2e_ms", "h",
+                          buckets=metrics.LATENCY_MS_BUCKETS)
+        tr = slo.SLOTracker(label="t1", target_p99_ms=100.0,
+                            windows=(1.0, 10.0),
+                            source=slo.local_source(
+                                histogram="paddle_t_e2e_ms",
+                                registry=reg))
+        tr.tick(0.0)
+        for _ in range(98):
+            h.observe(10.0)
+        h.observe(5000.0)
+        h.observe(5000.0)
+        tr.tick(0.9)
+        v = tr.verdict(1.0)
+        fast = v["windows"]["fast"]
+        assert fast["requests"] == 100
+        assert fast["bad"] == 2.0
+        # 2% bad over a 1% budget: burning at twice budget
+        assert fast["burn_rate"] == pytest.approx(2.0, rel=0.01)
+        assert fast["percentiles_ms"]["p50"] <= 25.0
+        assert fast["percentiles_ms"]["p99"] >= 100.0
+        assert v["alerting"] is True
+        tr.close()
+
+    def test_violation_seconds_and_gauges(self):
+        reg = _reg()
+        h = reg.histogram("paddle_t_e2e_ms", "h",
+                          buckets=metrics.LATENCY_MS_BUCKETS)
+        tr = slo.SLOTracker(label="t2", target_p99_ms=50.0,
+                            windows=(1.0, 10.0),
+                            source=slo.local_source(
+                                histogram="paddle_t_e2e_ms",
+                                registry=reg))
+        tr.tick(0.0)
+        for _ in range(10):
+            h.observe(500.0)  # everything over target
+        assert tr.tick(0.5) > 1.0
+        tr.tick(1.0)
+        assert tr.violation_seconds == pytest.approx(0.5)
+        text = metrics.REGISTRY.expose_text()
+        assert 'paddle_slo_burn_rate{tracker="t2",window="fast"}' \
+            in text
+        assert 'paddle_slo_violation_seconds_total{tracker="t2"}' \
+            in text
+        tr.close()
+        text = metrics.REGISTRY.expose_text()
+        assert 'tracker="t2"' not in text  # retired on close
+
+    def test_shed_and_deadline_count_as_bad(self):
+        reg = _reg()
+        reg.histogram("paddle_t_e2e_ms", "h",
+                      buckets=metrics.LATENCY_MS_BUCKETS)
+        shed = reg.counter("paddle_t_shed_total", "shed")
+        tr = slo.SLOTracker(label="t3", target_p99_ms=1000.0,
+                            windows=(1.0, 10.0),
+                            source=slo.local_source(
+                                histogram="paddle_t_e2e_ms",
+                                bad_counters=("paddle_t_shed_total",),
+                                registry=reg))
+        tr.tick(0.0)
+        shed.inc(5)
+        assert tr.tick(0.5) > 1.0  # 5 bad / 5 total >> budget
+        tr.close()
+
+    def test_flag_construction_defaults(self, monkeypatch):
+        calls = []
+        orig = ptpu.config.get_flag
+
+        def counting(name):
+            calls.append(name)
+            return orig(name)
+        monkeypatch.setattr(ptpu.config, "get_flag", counting)
+        tr = slo.SLOTracker(label="t4", target_p99_ms=10.0,
+                            source=lambda: {"buckets": (), "counts":
+                                            [], "count": 0, "bad": 0})
+        assert calls.count("slo_windows") == 1
+        assert calls.count("slo_target_p99_ms") == 0  # passed in
+        assert tr.windows == (5.0, 60.0)
+        calls.clear()
+        tr.tick()
+        tr.verdict()
+        assert not [c for c in calls if c.startswith("slo_")]
+        tr.close()
+
+
+class TestSLOBurnTrip:
+    def test_slow_member_trips_fast_window_with_zero_errors(self):
+        """Acceptance: an injected slow member pushes client-observed
+        latency over target; the fast window alerts within one window
+        while every request still succeeds."""
+        router = FleetRouter(heartbeat_timeout_ms=400,
+                             replay_attempts=2,
+                             slo_target_p99_ms=50.0,
+                             slo_windows=(0.75, 8.0))
+        worker = None
+        try:
+            assert router.slo is not None
+            worker = EngineWorker(FakeBackend(delay=0.12),
+                                  member_id="slow0",
+                                  router_addr=router.addr,
+                                  heartbeat_ms=100)
+            t0 = time.monotonic()
+            futs = [router.submit([4, 5], max_new_tokens=2)
+                    for _ in range(6)]
+            errors = [f for f in futs
+                      if f.result(timeout=60) is None]
+            assert not errors
+            deadline = t0 + 0.75 + 5.0  # one fast window + slack
+            while not router.slo.alerting and \
+                    time.monotonic() < deadline:
+                time.sleep(0.03)
+            elapsed = time.monotonic() - t0
+            assert router.slo.alerting, \
+                "fast-window burn alert never tripped"
+            v = router.slo.verdict()
+            assert v["alerting"] is True
+            assert v["windows"]["fast"]["burn_rate"] > 1.0
+            assert elapsed < deadline - t0
+        finally:
+            if worker is not None:
+                worker.close()
+            router.close()
+
+
+# -- introspection surfaces ------------------------------------------------
+
+class TestIntrospection:
+    def test_debug_fleet_and_slo_and_member_metrics(self):
+        router = FleetRouter(heartbeat_timeout_ms=1000,
+                             metrics_interval_ms=50,
+                             slo_target_p99_ms=100.0)
+        worker = None
+        srv = ohttp.start_server(0)
+        try:
+            worker = EngineWorker(FakeBackend(), member_id="w0",
+                                  router_addr=router.addr,
+                                  heartbeat_ms=40,
+                                  metrics_interval_ms=50)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if router._aggregator.fleet_doc()["ingests"] > 0:
+                    break
+                time.sleep(0.02)
+            code, body = _get(srv.url + "/debug/fleet")
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["members"]["w0"]["state"] == "live"
+            assert doc["members"]["w0"]["telemetry"]["ingests"] >= 1
+            assert "generation" in doc and "slo" in doc
+            code, body = _get(srv.url + "/debug/slo")
+            assert code == 200
+            verdict = json.loads(body)
+            assert "windows" in verdict and "alerting" in verdict
+            # merged /metrics plus per-member drill-down
+            code, body = _get(srv.url + "/metrics")
+            assert code == 200
+            assert "paddle_fleet_members_live" in body
+            code, body = _get(srv.url + "/metrics?member=w0")
+            assert code == 200
+            assert "paddle_" in body
+            code, _ = _get(srv.url + "/metrics?member=ghost",
+                           expect=404)
+            assert code == 404
+        finally:
+            if worker is not None:
+                worker.close()
+            router.close()
+            ohttp.stop_server()
+
+    def test_metrics_endpoint_falls_back_after_router_close(self):
+        srv = ohttp.start_server(0)
+        router = FleetRouter(heartbeat_timeout_ms=0)
+        try:
+            router.close()
+            code, body = _get(srv.url + "/metrics")
+            assert code == 200
+            assert body == metrics.REGISTRY.expose_text()
+        finally:
+            router.close()
+            ohttp.stop_server()
+
+    def test_chrome_trace_export(self):
+        ptpu.config.set_flags(request_tracing=True,
+                              trace_sample_rate=1.0)
+        srv = ohttp.start_server(0)
+        try:
+            ctx = rtrace.mint("unit", prompt_len=3)
+            sid = rtrace.event(ctx, "prefill", dur_ms=12.5, session=1)
+            rtrace.event(ctx, "memberRecv", parent=sid,
+                         member="m0", pid=4242)
+            doc = rtrace.chrome_trace(ctx.trace_id)
+            assert doc["displayTimeUnit"] == "ms"
+            evs = doc["traceEvents"]
+            metas = [e for e in evs if e["ph"] == "M"]
+            slices = [e for e in evs if e["ph"] == "X"]
+            instants = [e for e in evs if e["ph"] == "i"]
+            assert metas and slices and instants
+            x = slices[0]
+            assert x["dur"] == pytest.approx(12.5 * 1e3)
+            # cross-process lanes: the member pid got its own track
+            assert any(e.get("pid") == 4242 for e in evs
+                       if e["ph"] != "M")
+            code, body = _get(srv.url + "/debug/trace?id=%s&fmt=chrome"
+                              % ctx.trace_id)
+            assert code == 200
+            assert json.loads(body)["traceEvents"]
+            code, _ = _get(srv.url + "/debug/trace?id=nope&fmt=chrome",
+                           expect=404)
+            assert code == 404
+            assert rtrace.chrome_trace("nope") is None
+        finally:
+            ohttp.stop_server()
+
+    def test_flight_bundle_carries_fleet_context(self, tmp_path):
+        ptpu.config.set_flags(flight_dir=str(tmp_path))
+        router = FleetRouter(heartbeat_timeout_ms=0,
+                             slo_target_p99_ms=75.0)
+        name = router._health_name
+        try:
+            path = flight.RECORDER.dump("unit_fleet_ctx")
+            assert path is not None
+            bundle = flight.RECORDER.latest()
+            ctx = bundle["context"][name]
+            assert "members" in ctx["fleet"]
+            assert ctx["fleet"]["router"].startswith("f")
+            assert ctx["slo"]["target_p99_ms"] == 75.0
+        finally:
+            router.close()
+        # after close the context is gone from new bundles
+        path = flight.RECORDER.dump("unit_fleet_ctx_closed")
+        assert path is not None
+        assert name not in flight.RECORDER.latest()["context"]

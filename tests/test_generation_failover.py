@@ -490,6 +490,9 @@ class TestDefaultOff:
         assert ptpu.config.get_flag("compile_cache_max_bytes") == 0
         assert ptpu.config.get_flag("request_tracing") is False
         assert ptpu.config.get_flag("telemetry_port") == 0
+        assert ptpu.config.get_flag("fleet_metrics_interval_ms") == 0
+        assert ptpu.config.get_flag("slo_target_p99_ms") == 0
+        assert ptpu.config.get_flag("slo_windows") == (5.0, 60.0)
 
     def test_dispatcher_hot_path_reads_no_flags(self, monkeypatch):
         """Acceptance: with the flags at defaults the dispatcher loop
@@ -532,7 +535,7 @@ class TestDefaultOff:
                                          "trace_sample_rate",
                                          "telemetry_port",
                                          "flight_dir",
-                                         "fleet_"))]
+                                         "fleet_", "slo_"))]
             workers = [t for t in threading.enumerate()
                        if t.name.startswith("generation-step-")]
             assert not workers

@@ -1,0 +1,100 @@
+"""Metric-hygiene lint (tools/check_metrics.py) as a tier-1 gate:
+the real tree must scan clean, and the lint itself must catch each
+violation class it promises to."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import check_metrics  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestRealTree:
+    def test_tree_is_clean(self):
+        assert check_metrics.check(REPO) == []
+
+    def test_cli_exit_status(self):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "check_metrics.py"), REPO],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def _scan_src(tmp_path, src):
+    pkg = tmp_path / "paddle_tpu"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "mod.py").write_text(src)
+    return check_metrics.check(str(tmp_path))
+
+
+class TestViolations:
+    def test_missing_prefix(self, tmp_path):
+        probs = _scan_src(tmp_path,
+                          'REGISTRY.counter("requests_total", "h")\n')
+        assert len(probs) == 1 and "paddle_-prefixed" in probs[0]
+
+    def test_not_snake_case(self, tmp_path):
+        probs = _scan_src(
+            tmp_path, 'REGISTRY.gauge("paddle_Queue_Depth", "h")\n')
+        assert len(probs) == 1 and "snake_case" in probs[0]
+        probs = _scan_src(
+            tmp_path, 'REGISTRY.gauge("paddle__double", "h")\n')
+        assert len(probs) == 1 and "snake_case" in probs[0]
+        probs = _scan_src(
+            tmp_path, 'REGISTRY.gauge("paddle_trailing_", "h")\n')
+        assert len(probs) == 1 and "snake_case" in probs[0]
+
+    def test_dynamic_name_on_registry_flagged(self, tmp_path):
+        probs = _scan_src(
+            tmp_path,
+            'REGISTRY.counter("paddle_%s_total" % kind, "h")\n')
+        assert len(probs) == 1
+        assert "not statically resolvable" in probs[0]
+
+    def test_module_constant_name_resolves(self, tmp_path):
+        probs = _scan_src(
+            tmp_path,
+            '_NAME = "paddle_const_total"\n'
+            'REGISTRY.counter(_NAME, "h")\n')
+        assert probs == []
+
+    def test_divergent_help_texts(self, tmp_path):
+        probs = _scan_src(
+            tmp_path,
+            'REGISTRY.counter("paddle_x_total", "one help")\n'
+            'REGISTRY.counter("paddle_x_total", "other help")\n')
+        assert len(probs) == 1
+        assert "different help texts" in probs[0]
+
+    def test_same_help_twice_is_fine(self, tmp_path):
+        probs = _scan_src(
+            tmp_path,
+            'REGISTRY.counter("paddle_x_total", "same")\n'
+            'REGISTRY.counter("paddle_x_total", "same")\n')
+        assert probs == []
+
+    def test_kind_conflict(self, tmp_path):
+        probs = _scan_src(
+            tmp_path,
+            'REGISTRY.counter("paddle_x_total", "h")\n'
+            'REGISTRY.gauge("paddle_x_total", "h")\n')
+        assert len(probs) == 1 and "multiple kinds" in probs[0]
+
+    def test_unrelated_methods_ignored(self, tmp_path):
+        probs = _scan_src(
+            tmp_path,
+            'stats.counter(key, "whatever")\n'
+            'obj.histogram(values)\n')
+        assert probs == []
+
+    def test_unparseable_file_reported(self, tmp_path):
+        probs = _scan_src(tmp_path, "def broken(:\n")
+        assert len(probs) == 1 and "unparseable" in probs[0]

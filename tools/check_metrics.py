@@ -1,0 +1,162 @@
+"""Metric-hygiene lint: statically scan the tree for registry
+registrations and enforce the naming contract CI-side.
+
+Every ``REGISTRY.counter/gauge/histogram`` call in ``paddle_tpu/``
+must register a name that is
+
+* resolvable statically — a string literal, or a module-level
+  ``_CONSTANT = "..."`` in the same file (dynamic names defeat both
+  this lint and anyone grepping an alert back to its source),
+* ``paddle_``-prefixed (the exposition namespace),
+* snake_case (``[a-z0-9_]``, no leading/trailing/double underscores),
+* registered with a single help text — the same name re-registered
+  elsewhere must carry the identical help string (the registry keeps
+  the first; a silently differing duplicate is drift).
+
+Wired as a tier-1 test (tests/test_metrics_lint.py) and runnable
+standalone:
+
+    python tools/check_metrics.py [root]
+
+Exit status 0 = clean; 1 = violations (printed one per line).
+"""
+
+import ast
+import os
+import re
+import sys
+
+NAME_RE = re.compile(r"^paddle(_[a-z0-9]+)+$")
+REGISTER_METHODS = ("counter", "gauge", "histogram")
+
+
+def _module_constants(tree):
+    """{NAME: string} for module-level ``NAME = "literal"`` bindings
+    (the ``_LABEL_EVICTIONS_NAME`` pattern)."""
+    consts = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            consts[node.targets[0].id] = node.value.value
+    return consts
+
+
+def _literal_str(node, consts):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _help_text(call, consts):
+    """The help argument: positional #2 or ``help_text=``; adjacent
+    implicitly-concatenated literals arrive as one ast.Constant."""
+    node = None
+    if len(call.args) >= 2:
+        node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "help_text":
+            node = kw.value
+    if node is None:
+        return ""
+    # "a" "b" concatenation folds at parse; BinOp + of literals is
+    # the other spelling long help strings use
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _literal_str(node.left, consts)
+        right = _literal_str(node.right, consts)
+        if left is not None and right is not None:
+            return left + right
+        return None
+    return _literal_str(node, consts)
+
+
+def scan_file(path, registrations, problems):
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as exc:
+        problems.append("%s: unparseable: %s" % (path, exc))
+        return
+    consts = _module_constants(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in REGISTER_METHODS):
+            continue
+        # only registry registrations: REGISTRY.counter(...),
+        # reg.histogram(...), self.counter(...) — not arbitrary
+        # same-named methods; require a string-ish first argument
+        if not node.args:
+            continue
+        where = "%s:%d" % (path, node.lineno)
+        name = _literal_str(node.args[0], consts)
+        if name is None:
+            # non-literal first arg: only flag it when it's clearly a
+            # metrics registration (named on a registry-like object)
+            base = fn.value
+            basename = getattr(base, "id", None) or \
+                getattr(base, "attr", None)
+            if basename in ("REGISTRY", "reg", "registry",
+                            "_metrics"):
+                problems.append(
+                    "%s: %s() name is not statically resolvable"
+                    % (where, fn.attr))
+            continue
+        if not name.startswith("paddle_"):
+            problems.append("%s: metric %r is not paddle_-prefixed"
+                            % (where, name))
+            continue
+        if not NAME_RE.match(name):
+            problems.append("%s: metric %r is not snake_case"
+                            % (where, name))
+            continue
+        help_text = _help_text(node, consts)
+        registrations.setdefault(name, []).append(
+            (where, help_text, fn.attr))
+
+
+def check(root):
+    """Scan ``<root>/paddle_tpu`` (and tools/, which registers
+    nothing but must stay clean). Returns a list of problems."""
+    registrations, problems = {}, []
+    for top in ("paddle_tpu",):
+        for dirpath, _dirnames, filenames in os.walk(
+                os.path.join(root, top)):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    scan_file(os.path.join(dirpath, fn),
+                              registrations, problems)
+    for name, sites in sorted(registrations.items()):
+        helps = {h for _w, h, _k in sites if h is not None}
+        if len(helps) > 1:
+            problems.append(
+                "metric %r registered with %d different help texts: %s"
+                % (name, len(helps),
+                   "; ".join(w for w, _h, _k in sites)))
+        kinds = {k for _w, _h, k in sites}
+        if len(kinds) > 1:
+            problems.append(
+                "metric %r registered as multiple kinds %s: %s"
+                % (name, sorted(kinds),
+                   "; ".join(w for w, _h, _k in sites)))
+    return problems
+
+
+def main(argv):
+    root = argv[1] if len(argv) > 1 else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    problems = check(root)
+    for p in problems:
+        print(p)
+    print("%d metric registration problem(s)" % len(problems))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

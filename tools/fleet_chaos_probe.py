@@ -19,6 +19,16 @@ seeded weights) behind a :class:`FleetRouter`, with:
 * a cold member spawned against the warm persistent compile cache
   (PR 7): scale-up is measured as spawn-to-first-token.
 
+The telemetry plane (ISSUE 16) rides the whole scenario: every worker
+ships registry snapshots on its heartbeats, and after the kill the
+probe proves the conservation ledger — the fleet-aggregated
+``paddle_fleet_worker_done_total`` converges on EXACTLY the number of
+completed requests (the dead member completed none; restarts
+double-count nothing) — then shows the dead member's snapshot
+retained-but-stale in the fleet doc and the router SLO tracker's
+fast-window burn-rate alert tripping on the (CPU-slow) request
+latencies with zero client errors.
+
 Prints the recovery counters, latency percentiles, and a final OK
 line; exits non-zero if any invariant breaks.
 
@@ -112,18 +122,26 @@ def main():
                       "tokens": sum(map(len, baseline))}))
 
     print("== fleet: 3 worker processes, SIGKILL m0 mid-generation ==")
+    # telemetry plane on: workers ship snapshots every 100ms; the
+    # router-side window is long (30s) so the dead member's retained
+    # snapshot is still visible when we inspect it, and the SLO
+    # tracker watches fleet request latency (CPU-slow decode blows the
+    # 100ms target, so the burn alert MUST trip — with zero errors)
     router = FleetRouter(heartbeat_timeout_ms=700, replay_attempts=6,
                          breaker_failures=2,
                          breaker_cooldown_ms=60000.0,
-                         canary_fraction=0.34)
+                         canary_fraction=0.34,
+                         metrics_interval_ms=30000.0,
+                         slo_target_p99_ms=100.0)
+    ship = ["--metrics-interval-ms", "100"]
     procs = []
     try:
         t_spawn0 = time.perf_counter()
         for mid, extra in (("m0", ["--kill-at-token",
                                    str(KILL_AT_TOKEN),
-                                   "--fail-after-swap", "bad"]),
-                           ("m1", ["--fail-after-swap", "bad"]),
-                           ("m2", ["--fail-after-swap", "bad"])):
+                                   "--fail-after-swap", "bad"] + ship),
+                           ("m1", ["--fail-after-swap", "bad"] + ship),
+                           ("m2", ["--fail-after-swap", "bad"] + ship)):
             procs.append(spawn(router, mid, cache_dir, *extra)[0])
         router.wait_members(3, timeout=180)
         print(json.dumps({"members": router.members_live(),
@@ -176,10 +194,56 @@ def main():
         assert router.members_live() == ["m1", "m2"]
         assert counter("paddle_fleet_member_deaths_total") >= 1
 
+        print("== telemetry: conservation, staleness, burn rate ==")
+        # conservation: every request completed on exactly one worker;
+        # m0 died at streamed token 4 having completed none, so the
+        # fleet-aggregated done total must converge on EXACTLY the
+        # request count — lost-member tails lose nothing, and nothing
+        # is counted twice
+        def fleet_done():
+            return router._aggregator.counter_value(
+                "paddle_fleet_worker_done_total")
+        deadline = time.monotonic() + 30
+        while fleet_done() < N_REQUESTS and \
+                time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert fleet_done() == N_REQUESTS, \
+            "fleet done %.0f != %d completed" % (fleet_done(),
+                                                 N_REQUESTS)
+        done_after_kill = fleet_done()
+        doc = router.fleet_doc()
+        m0 = doc["members"]["m0"]
+        assert m0["state"] == "dead"
+        assert m0["telemetry"]["stale"] and m0["telemetry"]["dead"]
+        # the slow fleet burns error budget fast — the multi-window
+        # tracker must alert on the fast window, with 0 client errors
+        deadline = time.monotonic() + 10
+        while not router.slo.alerting and \
+                time.monotonic() < deadline:
+            time.sleep(0.1)
+        verdict = router.slo.verdict()
+        assert verdict["alerting"], \
+            "fast-window burn alert never tripped: %r" % verdict
+        print(json.dumps({
+            "fleet_worker_done_total": fleet_done(),
+            "requests_completed": N_REQUESTS,
+            "conserved": fleet_done() == N_REQUESTS,
+            "m0_snapshot": {"state": m0["state"],
+                            "stale": m0["telemetry"]["stale"],
+                            "ingests": m0["telemetry"]["ingests"]},
+            "slo": {"alerting": verdict["alerting"],
+                    "fast_burn": round(
+                        verdict["windows"]["fast"]["burn_rate"], 1),
+                    "fast_p99_ms": verdict["windows"]["fast"]
+                    ["percentiles_ms"]["p99"],
+                    "violation_seconds": round(
+                        verdict["violation_seconds"], 2)},
+        }, indent=1))
+
         print("== scale-up: cold member against the warm compile "
               "cache ==")
         t_up0 = time.perf_counter()
-        proc3, port3 = spawn(router, "m3", cache_dir)
+        proc3, port3 = spawn(router, "m3", cache_dir, *ship)
         procs.append(proc3)
         ready_ms = (time.perf_counter() - t_up0) * 1e3
         conn = wire.LineConn.connect(("127.0.0.1", port3),
@@ -258,13 +322,16 @@ def main():
                 print(line)
         print("FLEET CHAOS PROBE OK: %d/%d served bit-identical "
               "through a SIGKILL (failover=%d, deaths=%d, "
-              "recovery p50<=%.0f ms), scale-up-to-first-token "
-              "%.0f ms, rolling deploy committed + bad push rolled "
-              "back with 0 client errors"
+              "recovery p50<=%.0f ms), fleet counters conserved "
+              "(%d==%d) with the dead member stale-labeled, SLO "
+              "fast-window burn alert tripped with 0 errors, "
+              "scale-up-to-first-token %.0f ms, rolling deploy "
+              "committed + bad push rolled back with 0 client errors"
               % (N_REQUESTS, N_REQUESTS,
                  counter("paddle_fleet_failover_total"),
                  counter("paddle_fleet_member_deaths_total"),
-                 hist_pct(recov, 50), first_token_ms))
+                 hist_pct(recov, 50), int(done_after_kill),
+                 N_REQUESTS, first_token_ms))
     finally:
         router.close()
         for p in procs:
