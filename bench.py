@@ -730,6 +730,7 @@ def bench_generation(on_accel):
         "vs_baseline": 1.0,  # no reference analog; tripwire-only
         "slots": slots,
         "steps": steps,
+        "policy": "greedy",  # decode-policy the line was measured under
     }, {
         "metric": "time_to_first_token_ms" + suffix,
         "value": round(float(np.median(ttft)), 2),
@@ -739,6 +740,7 @@ def bench_generation(on_accel):
         # prefill is a single small-batch step; ms-scale host jitter
         # dominates relative drift below this
         "regression_floor": 5.0,
+        "policy": "greedy",
     }, {
         "metric": "inter_token_ms" + suffix,
         "value": round(float(np.median(step_ms)), 2),
@@ -746,6 +748,105 @@ def bench_generation(on_accel):
         "higher_is_better": False,
         "vs_baseline": 1.0,
         "regression_floor": 2.0,
+        "policy": "greedy",
+    }]
+
+
+def bench_speculative(on_accel):
+    """Speculative-decoding accept rate (ISSUE 17), tripwired:
+
+    * ``speculative_accept_rate`` — accepted / drafted tokens of a
+      1-layer truncated self-draft against the full target, single
+      slot. A drop means the verify kernel, the draft mirror, or the
+      COW rollback started disagreeing with the plain decode path —
+      rate is a correctness canary, not just a perf number.
+
+    The weight regime mirrors tools/decode_policy_probe.py: LayerNorms
+    at real init (gain 1 / bias 0) and residual-writing projections
+    (attention out-proj, ffn2) scaled by eps/sqrt(fan_in), so the
+    stream is embedding-dominated and the truncated draft genuinely
+    predicts the target's argmax most steps."""
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+    from paddle_tpu.models.transformer import (transformer_lm,
+                                               transformer_lm_session)
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.serving.decoding import DecodePolicy
+    from paddle_tpu.serving.generation import GenerationSession
+
+    vocab = 256
+    kw = dict(d_model=256, num_heads=4, d_ff=1024, num_layers=6) \
+        if on_accel else dict(d_model=128, num_heads=2, d_ff=512,
+                              num_layers=4)
+    steps = 96 if on_accel else 48
+    max_len = 16 + steps
+    suffix = "" if on_accel else "_cpu_smoke"
+
+    with ptpu.unique_name.guard():
+        main_prog, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main_prog, startup):
+            toks = layers.data("toks", shape=[1, max_len],
+                               dtype="int64", append_batch_size=False)
+            lbls = layers.data("lbls", shape=[1, max_len],
+                               dtype="int64", append_batch_size=False)
+            transformer_lm(toks, lbls, vocab_size=vocab, is_test=True,
+                           **kw)
+    exe = ptpu.Executor()
+    exe.run(startup)
+    scope = ptpu.global_scope()
+    rs = np.random.RandomState(7)
+    for n in sorted(scope.var_names()):
+        cur = np.asarray(scope.find_var(n))
+        if not np.issubdtype(cur.dtype, np.floating):
+            continue
+        if n.startswith("layer_norm"):
+            continue
+        w = rs.standard_normal(cur.shape)
+        if ".o.w" in n or ".ffn2." in n:
+            fan_in = cur.shape[0] if cur.ndim == 2 else 1
+            w = w * (1e-3 / np.sqrt(max(fan_in, 1)))
+        scope.set_var(n, w.astype(cur.dtype))
+
+    def counter(name):
+        for s in (metrics.REGISTRY.dump().get(name, {})
+                  .get("samples", ())):
+            return s["value"]
+        return 0.0
+
+    prompt = [0, 5, 7, 11]
+    base_sess = GenerationSession(transformer_lm_session(
+        vocab, max_len=max_len, slots=1, prompt_buckets=(8,),
+        paged=True, block_size=16, **kw))
+    base = base_sess.generate(prompt, max_new_tokens=steps, eos_id=-1)
+    base_sess.close()
+
+    d0 = counter("paddle_generation_speculative_drafted_total")
+    a0 = counter("paddle_generation_speculative_accepted_total")
+    sess = GenerationSession(transformer_lm_session(
+        vocab, max_len=max_len, slots=1, prompt_buckets=(8,),
+        paged=True, block_size=16,
+        decode_policy=DecodePolicy(kind="greedy", speculate_k=4),
+        **kw))
+    out = sess.generate(prompt, max_new_tokens=steps, eos_id=-1)
+    sess.check_pool_invariant()
+    sess.close()
+    if out != base:
+        raise RuntimeError(
+            "speculative decode diverged from plain greedy — the "
+            "verify kernel re-decides every position, so any draft "
+            "must be trajectory-neutral")
+    drafted = counter(
+        "paddle_generation_speculative_drafted_total") - d0
+    accepted = counter(
+        "paddle_generation_speculative_accepted_total") - a0
+
+    return [{
+        "metric": "speculative_accept_rate" + suffix,
+        "value": round(accepted / max(drafted, 1.0), 3),
+        "unit": "accepted/drafted tokens (1-layer self-draft, k=4)",
+        "vs_baseline": 1.0,  # no reference analog; tripwire-only
+        "steps": steps,
+        "policy": "speculative(greedy,k=4)",
     }]
 
 
@@ -1534,6 +1635,8 @@ def main():
              lambda: bench_deploy(on_accel)),
             ("decode_tokens_per_sec",
              lambda: bench_generation(on_accel)),
+            ("speculative_accept_rate",
+             lambda: bench_speculative(on_accel)),
             ("kv_cache_bytes_per_token",
              lambda: bench_paged_kv(on_accel)),
             ("generation_failover_recovery_ms",

@@ -193,6 +193,27 @@ generation_prefix_cache: paged-KV-cache defaults for
   only at session construction — generation unused costs zero flag
   checks anywhere, and the dense decode path consults none of them.
 
+decode_policy / decode_temperature / decode_top_k / decode_top_p /
+decode_speculate_k / decode_draft_model / decode_constraint: the
+  decode-policy tier (serving/decoding/, ops/decoding_ops.py).
+  ``decode_policy`` is "greedy" (default) or "sample";
+  temperature/top-k/top-p parameterize sampling (RNG is counter-keyed
+  per request seed + token position, so sampled streams replay
+  bit-identically through PR-9 session failover and PR-13 fleet
+  hops). ``decode_speculate_k`` > 0 turns on speculative decoding
+  (requires the paged KV layout): a draft model proposes k tokens per
+  round and ONE suffix-window forward pass verifies them;
+  ``decode_draft_model`` is a dict of transformer_lm_session
+  overrides for the draft (None = 1-layer truncated self-draft
+  sharing the target's weights). ``decode_constraint`` is a
+  TokenConstraint (serving/decoding/constrain.py) whose per-state
+  [vocab] -inf mask rows are added to the logits on device. ALL read
+  exactly once, at session construction, inside
+  ``DecodePolicy.from_flags`` — and the all-defaults combination
+  constructs nothing: spec.policy is None, the epilogue is the same
+  arg_max, and the dispatcher hot path reads no decode_* flag
+  (counting-asserted in tests/test_generation_failover.py).
+
 compile_cache_max_bytes: 0 (default) = the persistent compile cache
   dir grows without bound (the pre-cap behavior). When set, store()
   evicts coldest-mtime entries (bin+manifest together; load() hits
@@ -340,6 +361,18 @@ _flags = {
     "generation_block_size": 16,
     "generation_pool_blocks": 0,
     "generation_prefix_cache": False,
+    # decode policy (serving/decoding/; read only at session
+    # construction via DecodePolicy.from_flags — the all-defaults
+    # combination resolves to NO policy object at all, so the greedy
+    # argmax epilogue, programs, and dispatcher hot path stay
+    # byte-identical and flag-check-count-identical to PR-8..16)
+    "decode_policy": "greedy",
+    "decode_temperature": 1.0,
+    "decode_top_k": 0,
+    "decode_top_p": 1.0,
+    "decode_speculate_k": 0,
+    "decode_draft_model": None,
+    "decode_constraint": None,
     # persistent compile cache size cap (core/compile_cache.py)
     "compile_cache_max_bytes": 0,
     # request-scoped tracing + flight recorder + live introspection

@@ -83,10 +83,23 @@ class BeamSearchDecoder:
     """
 
     def __init__(self, beam_size=4, max_len=32, bos_id=0, eos_id=1,
-                 length_penalty="avg", name=None, main_program=None):
+                 length_penalty="avg", name=None, main_program=None,
+                 decode="beam", sample_seed=0, temperature=1.0,
+                 top_k=0, top_p=1.0):
+        if decode not in ("beam", "sample"):
+            raise ValueError("decode must be 'beam' or 'sample', got "
+                             "%r" % (decode,))
+        if decode == "sample" and beam_size != 1:
+            raise ValueError("decode='sample' needs beam_size=1 (one "
+                             "sampled trajectory per source)")
         self.helper = LayerHelper("beam_search_decoder", name=name,
                                   main_program=main_program)
         self.program = self.helper.main_program
+        self.decode = decode
+        self.sample_seed = int(sample_seed)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
         self.beam_size = beam_size
         self.max_len = max_len
         self.bos_id = bos_id
@@ -188,7 +201,11 @@ class BeamSearchDecoder:
                    "captured_vars": captured,
                    "beam_size": K, "max_len": L,
                    "bos_id": self.bos_id, "eos_id": self.eos_id,
-                   "length_penalty": self.length_penalty},
+                   "length_penalty": self.length_penalty,
+                   "decode": self.decode,
+                   "sample_seed": self.sample_seed,
+                   "temperature": self.temperature,
+                   "top_k": self.top_k, "top_p": self.top_p},
             infer_shape=False)
         self._outs = (ids, length, scores)
 

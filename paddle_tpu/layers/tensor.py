@@ -7,6 +7,7 @@ __all__ = ["create_tensor", "cast", "concat", "sums", "assign",
            "fill_constant", "fill_constant_batch_size_like", "ones", "zeros",
            "reshape", "transpose", "flip", "split", "expand", "gather", "scatter",
            "pad", "crop", "sequence_reshape_noop", "argmax", "argmin",
+           "decode_sample", "decode_verify",
            "stack", "slice", "shape", "increment", "multiplex",
            "array_write", "array_read", "create_array"]
 
@@ -171,6 +172,43 @@ def argmax(x, axis=-1, **kwargs):
 def argmin(x, axis=-1, **kwargs):
     helper = LayerHelper("arg_min", **kwargs)
     return _unary(helper, "arg_min", x, {"axis": axis}, dtype="int64")
+
+
+def decode_sample(logits, seed, step, mask=None, temperature=1.0,
+                  top_k=0, top_p=1.0, **kwargs):
+    """Counter-keyed policy sampling (ops/decoding_ops.py): one token
+    per row of ``logits`` [N, V] under ``decoding_key(seed[i],
+    step[i])``; optional additive ``mask`` [N, V] for constrained
+    decode. Returns [N] int64."""
+    helper = LayerHelper("decode_sample", **kwargs)
+    inputs = {"Logits": [logits.name], "Seed": [seed.name],
+              "Step": [step.name]}
+    if mask is not None:
+        inputs["Mask"] = [mask.name]
+    out = helper.create_tmp_variable("int64", stop_gradient=True)
+    helper.append_op(type="decode_sample", inputs=inputs,
+                     outputs={"Out": [out.name]},
+                     attrs={"temperature": float(temperature),
+                            "top_k": int(top_k), "top_p": float(top_p)})
+    return out
+
+
+def decode_verify(logits, window, seed, hist, kind="greedy",
+                  temperature=1.0, top_k=0, top_p=1.0, **kwargs):
+    """Speculative accept step (ops/decoding_ops.py): target-policy
+    tokens at every suffix-window position plus the accepted-draft
+    count. Returns (tokens [W] int64, accept [1] int32)."""
+    helper = LayerHelper("decode_verify", **kwargs)
+    toks = helper.create_tmp_variable("int64", stop_gradient=True)
+    accept = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op(
+        type="decode_verify",
+        inputs={"Logits": [logits.name], "Window": [window.name],
+                "Seed": [seed.name], "Hist": [hist.name]},
+        outputs={"Tokens": [toks.name], "Accept": [accept.name]},
+        attrs={"kind": kind, "temperature": float(temperature),
+               "top_k": int(top_k), "top_p": float(top_p)})
+    return toks, accept
 
 
 def stack(x, axis=0, **kwargs):

@@ -116,7 +116,9 @@ def transformer_lm(tokens, labels, vocab_size, d_model=128, num_heads=4,
 def transformer_lm_generate(batch_anchor, vocab_size, d_model=128,
                             num_heads=4, d_ff=256, num_layers=2,
                             max_len=16, beam_size=4, bos_id=0, eos_id=1,
-                            return_all_beams=False):
+                            return_all_beams=False, decode="beam",
+                            sample_seed=0, temperature=1.0, top_k=0,
+                            top_p=1.0):
     """Beam-search generation from the causal LM via the generic
     BeamSearchDecoder (reference beam_search_op composability demo: the
     same decode engine drives GRU NMT and this transformer).
@@ -129,11 +131,25 @@ def transformer_lm_generate(batch_anchor, vocab_size, d_model=128,
     to this path's greedy (beam_size=1) output
     (tests/test_generation.py).
 
+    ``decode="sample"`` is the stochastic reference path: beam_size is
+    forced to 1 and each step samples under the SAME counter-key
+    schedule the cached session uses — ``decoding_key(sample_seed,
+    position)`` with temperature/top-k/top-p — so cached-vs-reference
+    parity tests cover stochastic decode too (the token at sequence
+    index *i* is keyed by (seed, i) on both paths; a session decoding
+    from a ``[bos]`` prompt with the same seed reproduces this path's
+    stream token-for-token).
+
     ``batch_anchor``: any [B, ...] variable sizing the batch (e.g. an
     int32 dummy [B, 1]). Returns (ids, lengths, scores).
     """
+    if decode == "sample":
+        beam_size = 1
     bs = layers.BeamSearchDecoder(beam_size=beam_size, max_len=max_len,
-                                  bos_id=bos_id, eos_id=eos_id)
+                                  bos_id=bos_id, eos_id=eos_id,
+                                  decode=decode, sample_seed=sample_seed,
+                                  temperature=temperature, top_k=top_k,
+                                  top_p=top_p)
     with bs.step():
         bs.token()                       # advances via history
         anchor = bs.state(batch_anchor)  # sizes the batch; never updated
@@ -155,7 +171,7 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
                            prompt_buckets=None, bos_id=0, eos_id=1,
                            cache_ns=None, dtype="float32", paged=None,
                            block_size=None, num_blocks=None,
-                           prefix_cache=None):
+                           prefix_cache=None, decode_policy="flags"):
     """Build the KV-cached generation programs for the causal LM — the
     O(L)-per-token production decode path (the O(L^2) reference is
     :func:`transformer_lm_generate`).
@@ -210,6 +226,25 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
     can run more decode lanes than the dense layout could afford,
     because a lane pins only its live blocks, not a worst-case row.
 
+    **Decode policy** (``decode_policy``, default ``"flags"``: resolve
+    the ``decode_*`` config flags via ``DecodePolicy.from_flags`` —
+    the ONLY place those flags are read): with a policy, the epilogues
+    stop being a hardcoded argmax. Sampling adds per-request
+    seed/position feeds and ends in the counter-keyed
+    ``decode_sample`` op; a constraint adds an additive logit-mask
+    feed; ``speculate_k > 0`` (paged only) additionally builds a
+    **verify program** — a suffix-window prefill at window W = k+1
+    whose epilogue (``decode_verify``) re-decides every window
+    position with the target's own logits and counts the accepted
+    draft prefix — plus a nested dense greedy **draft spec** (same
+    machinery, fresh cache namespace, by default a 1-layer truncation
+    of this model so it shares weights through the same scope; pass
+    ``decode_draft_model`` overrides and a separate draft scope for
+    an independently trained draft). ``decode_policy=None`` forces
+    plain greedy regardless of flags. The all-defaults flags resolve
+    to None: spec.policy is None and every program is byte-identical
+    to the PR-8..16 build.
+
     Returns a :class:`paddle_tpu.serving.generation.GenerationSpec`
     consumed by ``GenerationSession`` / ``GenerationScheduler``.
     """
@@ -217,6 +252,14 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
     from ..core import unique_name as _un
     from ..core.framework import Program, program_guard
     from ..serving.generation import GenerationSpec
+    from ..serving.decoding import DecodePolicy
+
+    if decode_policy == "flags":
+        decode_policy = DecodePolicy.from_flags()
+    policy = decode_policy
+    sampled = policy is not None and policy.sampled
+    constraint = None if policy is None else policy.constraint
+    spec_k = 0 if policy is None else policy.speculate_k
 
     if slots is None:
         slots = int(_config.get_flag("generation_slots"))
@@ -264,6 +307,11 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
         num_blocks = 0
         prefix_cache = False
         cache_shape = (slots, cache_len, d_model)
+    if spec_k and not paged:
+        raise ValueError("decode_speculate_k needs the paged KV "
+                         "layout (generation_paged_kv / paged=True): "
+                         "the verify pass is a suffix-window prefill "
+                         "and rollback is block decref")
 
     def make_cache_vars(program):
         block = program.global_block()
@@ -278,8 +326,43 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
             caches.append((ck, cv))
         return caches
 
+    def _policy_epilogue(row, seed=None, step=None, mask=None):
+        """row [n, V] -> next token [n] under the resolved policy.
+        The policy-off shape is the same argmax as ever; constraint
+        masks are ADDED to the logits (0 legal / -inf banned) before
+        whichever chooser runs."""
+        if mask is not None:
+            row = layers.elementwise_add(row, mask)
+        if sampled:
+            return layers.decode_sample(
+                row, seed, step, temperature=policy.temperature,
+                top_k=policy.top_k, top_p=policy.top_p)
+        return layers.argmax(row, axis=-1)
+
+    def _policy_feeds(prefix, n):
+        """Declare the per-program policy feeds: seed [n] int64 +
+        step [n] int32 when sampling (step = the generated token's
+        sequence position, the counter in decoding_key), mask [n, V]
+        when constrained. Returns (seed, step, mask) vars (None when
+        unused) and the extra feed names in order."""
+        seed = step = mask = None
+        names = []
+        if sampled:
+            seed = layers.data(prefix + "seed", shape=[n],
+                               dtype="int64", append_batch_size=False)
+            step = layers.data(prefix + "step", shape=[n],
+                               dtype="int32", append_batch_size=False)
+            names += [prefix + "seed", prefix + "step"]
+        if constraint is not None:
+            mask = layers.data(prefix + "mask",
+                               shape=[n, vocab_size], dtype="float32",
+                               append_batch_size=False)
+            names.append(prefix + "mask")
+        return seed, step, mask, tuple(names)
+
     prefill_programs = {}
     prefill_fetch = None
+    prefill_extra = ()
     for P in prompt_buckets:
         prog = Program()
         with _un.guard(), program_guard(prog, Program()):
@@ -310,16 +393,19 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
                 cache_ctx = {"mode": "prefill", "caches": None,
                              "slot": slot, "key_length": plen,
                              "max_len": max_len}
+            pseed, pstep, pmask, prefill_extra = _policy_feeds(
+                "gen.p", 1)
             cache_ctx["caches"] = make_cache_vars(prog)
             logits = _lm_backbone(
                 toks, vocab_size, d_model, num_heads, d_ff, num_layers,
                 is_test=True, cache_ctx=cache_ctx)
             # logits at the last REAL prompt position (ppos = len-1):
-            # [1,P,V] -> [P,1,V] -> [1,1,V] -> [1,V] -> argmax [1]
+            # [1,P,V] -> [P,1,V] -> [1,1,V] -> [1,V] -> next [1]
             by_time = layers.transpose(logits, [1, 0, 2])
             at = layers.gather(by_time, ppos)
             row = layers.reshape(at, [1, vocab_size])
-            nxt = layers.argmax(row, axis=-1)
+            nxt = _policy_epilogue(row, seed=pseed, step=pstep,
+                                   mask=pmask)
         prefill_programs[P] = prog
         prefill_fetch = nxt.name
 
@@ -338,12 +424,14 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
         else:
             cache_ctx = {"mode": "decode", "caches": None, "pos": dpos,
                          "max_len": max_len}
+        dseed, dstep, dmask, decode_extra = _policy_feeds(
+            "gen.d", slots)
         cache_ctx["caches"] = make_cache_vars(decode_program)
         logits = _lm_backbone(
             toks, vocab_size, d_model, num_heads, d_ff, num_layers,
             is_test=True, cache_ctx=cache_ctx)
         row = layers.reshape(logits, [slots, vocab_size])
-        nxt = layers.argmax(row, axis=-1)
+        nxt = _policy_epilogue(row, seed=dseed, step=dstep, mask=dmask)
     decode_fetch = nxt.name
 
     copy_program = None
@@ -367,6 +455,72 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
                                 "Dst": [cdst.name]},
                         outputs={"Out": [cvar.name]})
 
+    verify_program = None
+    verify_fetch = None
+    verify_feeds = None
+    draft_spec = None
+    if spec_k:
+        # speculative verify: ONE suffix-window prefill at window
+        # W = k+1 ([pending_token, draft_1..draft_k]) whose epilogue
+        # re-decides every window position with the TARGET's logits
+        # under the counter keys and counts the accepted draft prefix.
+        # Scoring row i sits at live length hist + i, so this is
+        # exactly the PR-10 paged window-prefill shape — batch 1, run
+        # per speculating slot (the low-batch latency regime
+        # speculation exists for).
+        W = spec_k + 1
+        verify_program = Program()
+        with _un.guard(), program_guard(verify_program, Program()):
+            vtok = layers.data("gen.vtok", shape=[1, W], dtype="int64",
+                               append_batch_size=False)
+            vlen = layers.data("gen.vlen", shape=[1], dtype="int32",
+                               append_batch_size=False)
+            vhist = layers.data("gen.vhist", shape=[1], dtype="int32",
+                                append_batch_size=False)
+            vpix = layers.data("gen.vpix", shape=[W], dtype="int32",
+                               append_batch_size=False)
+            vtab = layers.data("gen.vtab", shape=[max_blocks],
+                               dtype="int32", append_batch_size=False)
+            vseed = layers.data("gen.vseed", shape=[1], dtype="int64",
+                                append_batch_size=False)
+            cache_ctx = {"mode": "prefill", "layout": "paged",
+                         "caches": make_cache_vars(verify_program),
+                         "table": vtab, "hist": vhist, "pos_idx": vpix,
+                         "key_length": vlen, "max_len": max_len}
+            logits = _lm_backbone(
+                vtok, vocab_size, d_model, num_heads, d_ff, num_layers,
+                is_test=True, cache_ctx=cache_ctx)
+            vtoks, vaccept = layers.decode_verify(
+                logits, vtok, vseed, vhist, kind=policy.kind,
+                temperature=policy.temperature, top_k=policy.top_k,
+                top_p=policy.top_p)
+        verify_feeds = ("gen.vtok", "gen.vlen", "gen.vhist",
+                        "gen.vpix", "gen.vtab", "gen.vseed")
+        verify_fetch = (vtoks.name, vaccept.name)
+        # the draft: same session machinery, DENSE layout (its k/v
+        # rows are overwritten in place on rollback — no pool), plain
+        # greedy policy (a deterministic draft collapses modified
+        # rejection sampling to prefix matching; see decoding_ops).
+        # Default is a 1-layer truncation of the target: identical
+        # parameter names for the layers it keeps, so running it over
+        # the TARGET's scope shares embedding/head/layer-0 weights —
+        # a free self-draft. decode_draft_model overrides the dims
+        # (then give the session a separate draft scope).
+        dkw = dict(d_model=d_model, num_heads=num_heads, d_ff=d_ff,
+                   num_layers=1)
+        if policy.draft:
+            unknown = set(policy.draft) - set(dkw)
+            if unknown:
+                raise ValueError("decode_draft_model keys %r not in "
+                                 "%r" % (sorted(unknown),
+                                         sorted(dkw)))
+            dkw.update(policy.draft)
+        draft_spec = transformer_lm_session(
+            vocab_size, max_len=max_len, slots=slots,
+            cache_len=cache_len, prompt_buckets=prompt_buckets,
+            bos_id=bos_id, eos_id=eos_id, cache_ns=None, dtype=dtype,
+            paged=False, decode_policy=None, **dkw)
+
     def _rebuild():
         # the session-rebuild factory (serving.generation): identical
         # programs/parameters, but cache_ns=None forces a FRESH cache
@@ -380,7 +534,7 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
             eos_id=eos_id, cache_ns=None, dtype=dtype, paged=paged,
             block_size=block_size or None,
             num_blocks=num_blocks or None,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache, decode_policy=policy)
 
     return GenerationSpec(
         slots=slots, cache_len=cache_len, max_len=max_len,
@@ -389,18 +543,21 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
                           dtype)
                          for i in range(num_layers) for kv in ("k", "v")),
         prefill_programs=prefill_programs,
-        prefill_feeds=(("gen.ptok", "gen.plen", "gen.ppos",
-                        "gen.phist", "gen.ppix", "gen.ptab") if paged
-                       else ("gen.ptok", "gen.plen", "gen.ppos",
-                             "gen.slot")),
+        prefill_feeds=((("gen.ptok", "gen.plen", "gen.ppos",
+                         "gen.phist", "gen.ppix", "gen.ptab") if paged
+                        else ("gen.ptok", "gen.plen", "gen.ppos",
+                              "gen.slot")) + prefill_extra),
         prefill_fetch=prefill_fetch,
         decode_program=decode_program,
-        decode_feeds=(("gen.dtok", "gen.dpos", "gen.dtab") if paged
-                      else ("gen.dtok", "gen.dpos")),
+        decode_feeds=((("gen.dtok", "gen.dpos", "gen.dtab") if paged
+                       else ("gen.dtok", "gen.dpos")) + decode_extra),
         decode_fetch=decode_fetch,
         rebuild=_rebuild,
         paged=bool(paged), block_size=block_size,
         num_blocks=num_blocks, max_blocks=max_blocks,
         prefix_cache=bool(prefix_cache),
         copy_program=copy_program,
-        copy_feeds=("gen.csrc", "gen.cdst") if paged else None)
+        copy_feeds=("gen.csrc", "gen.cdst") if paged else None,
+        vocab_size=vocab_size, policy=policy,
+        verify_program=verify_program, verify_feeds=verify_feeds,
+        verify_fetch=verify_fetch, draft_spec=draft_spec)

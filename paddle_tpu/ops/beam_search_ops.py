@@ -175,6 +175,8 @@ def _dynamic_beam_search(ctx):
     bos = ctx.attr("bos_id", 0)
     eos = ctx.attr("eos_id", 1)
     length_penalty = ctx.attr("length_penalty", "avg")
+    decode_mode = ctx.attr("decode", "beam")
+    sample_seed = ctx.attr("sample_seed", 0)
 
     captured = dict(zip(cap_names, ctx.inputs("Captured")))
     init_states = ctx.inputs("InitStates")
@@ -212,8 +214,31 @@ def _dynamic_beam_search(ctx):
         env.update({prev: s for (prev, _), s in zip(dyn_vars, states)})
         _run_sub_block(sub, env, amp=amp)
         logp = jax.nn.log_softmax(env[logits_var], axis=-1)
-        new_scores, parent, token, new_done = beam_step(scores, logp,
-                                                        done, eos)
+        if decode_mode == "sample":
+            # K == 1 sampled trajectory, sharing the serving tier's
+            # counter-key schedule: the token written to history
+            # column t+1 sits at sequence index t+1, so its key is
+            # decoding_key(seed, t+1) — bit-identical to a cached
+            # session sampling from a [bos] prompt with this seed.
+            from .decoding_ops import sample_from_logits
+            logits = env[logits_var]               # [B*1, V]
+            n = logits.shape[0]
+            seeds = jnp.full((n,), sample_seed, jnp.int64)
+            steps = jnp.full((n,), t + 1, jnp.int32)
+            picked = sample_from_logits(
+                logits, seeds, steps,
+                temperature=ctx.attr("temperature", 1.0),
+                top_k=ctx.attr("top_k", 0),
+                top_p=ctx.attr("top_p", 1.0)).astype(jnp.int32)
+            token = jnp.where(done, eos, picked.reshape(-1, K))
+            rows = jnp.arange(n, dtype=jnp.int32)
+            gain = logp[rows, token.reshape(-1)].reshape(-1, K)
+            new_scores = scores + jnp.where(done, 0.0, gain)
+            parent = jnp.zeros_like(token)
+            new_done = done | (token == eos)
+        else:
+            new_scores, parent, token, new_done = beam_step(
+                scores, logp, done, eos)
         flat_src = (jnp.arange(B, dtype=jnp.int32)[:, None] * K
                     + parent).reshape(-1)
         from .control_flow_ops import _pin_carry_dtype

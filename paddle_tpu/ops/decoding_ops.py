@@ -1,0 +1,123 @@
+"""Decode-policy ops: on-device sampling and speculative verification.
+
+The serving decode path (serving/generation.py) historically ended in a
+hardcoded ``arg_max`` epilogue. These ops make "next token" a policy:
+
+* ``decode_sample`` — temperature / top-k / top-p sampling fused into
+  the decode (or prefill) epilogue. RNG is COUNTER-BASED: the op takes
+  the request seed and the token's sequence position as explicit feeds
+  and derives the key via :func:`~..ops.random_ops.decoding_key`
+  (``fold_in(PRNGKey(seed), position)``). Deliberately NOT
+  ``needs_rng``: the executor's stateful per-op key split would make
+  the sampled stream depend on execution history, which is exactly
+  what token-replay failover (PR-9 journals, PR-13 fleet hops) cannot
+  tolerate.
+* ``decode_verify`` — the speculative-decoding accept step (Leviathan
+  et al., "Fast Inference from Transformers via Speculative
+  Decoding"). One paged suffix-window forward pass scores the whole
+  draft window; this op computes the target policy's own token at
+  every window position under the same counter keys and accepts the
+  longest draft prefix that matches. Because the draft proposes
+  DETERMINISTICALLY (greedy), modified rejection sampling collapses to
+  exact prefix matching — accepted-or-corrected output is
+  token-for-token the trajectory the non-speculative target policy
+  would have produced, so speculation composes with journal replay
+  for free.
+
+Both ops are plain jnp/XLA (no Pallas): vocab-sized top-k/sort/scatter
+are textbook XLA patterns and the tensors are tiny next to the
+transformer stack they follow.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .random_ops import decoding_key
+
+_NEG_INF = -1e30
+
+
+def sample_from_logits(logits, seeds, steps, temperature=1.0, top_k=0,
+                       top_p=1.0):
+    """Policy-sample one token per row: ``logits`` [N, V] under keys
+    ``decoding_key(seeds[i], steps[i])``. The single implementation
+    shared by every sampling surface (decode epilogue, prefill
+    epilogue, speculative verify, beam-search sample mode, reference
+    path) — sharing it IS the replay contract."""
+    x = logits.astype(jnp.float32) / jnp.float32(temperature)
+    n, v = x.shape
+    if top_k and top_k > 0 and top_k < v:
+        kth = jax.lax.top_k(x, top_k)[0][:, -1:]
+        x = jnp.where(x < kth, _NEG_INF, x)
+    if top_p < 1.0:
+        sorted_x, sort_idx = jax.lax.top_k(x, v)  # full descending sort
+        probs = jax.nn.softmax(sorted_x, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix whose mass reaches top_p (the
+        # first token is always kept: cum - probs is 0 there)
+        keep = (cum - probs) < jnp.float32(top_p)
+        kept = jnp.where(keep, sorted_x, _NEG_INF)
+        rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+        x = jnp.full_like(x, _NEG_INF).at[rows, sort_idx].set(kept)
+    keys = jax.vmap(decoding_key)(jnp.asarray(seeds).reshape(-1),
+                                  jnp.asarray(steps).reshape(-1))
+    tok = jax.vmap(lambda k, row: jax.random.categorical(k, row))(keys, x)
+    return tok.astype(jnp.int64)
+
+
+@register_op("decode_sample")
+def _decode_sample(ctx):
+    """Inputs: Logits [N, V]; Seed [N] int64 (per-request RNG seed);
+    Step [N] int32 (sequence position of the token being generated);
+    optional Mask [N, V] additive float (0 legal / -inf banned — the
+    constrained-decoding row). Attrs: temperature (> 0), top_k
+    (0 = off), top_p (1.0 = off). Output: Out [N] int64 sampled
+    token ids."""
+    logits = ctx.input("Logits")
+    mask = ctx.input("Mask") if ctx.has_input("Mask") else None
+    if mask is not None:
+        logits = logits + mask.astype(logits.dtype)
+    out = sample_from_logits(
+        logits, ctx.input("Seed"), ctx.input("Step"),
+        temperature=ctx.attr("temperature", 1.0),
+        top_k=ctx.attr("top_k", 0), top_p=ctx.attr("top_p", 1.0))
+    return {"Out": out}
+
+
+@register_op("decode_verify")
+def _decode_verify(ctx):
+    """Speculative accept step over one suffix window.
+
+    Inputs: Logits [1, W, V] (suffix-window forward pass at
+    ``hist`` = live length L; row *i* scores the token at sequence
+    index L+i+1); Window [W] int64 — the window tokens as fed to the
+    forward pass: ``[pending_token, draft_1 .. draft_{W-1}]``; Seed
+    [1] int64; Hist [1] int32 (= L). Attrs: kind ("greedy"|"sample"),
+    temperature / top_k / top_p (sample kind only).
+
+    Outputs: Tokens [W] int64 — the TARGET policy's token at every
+    window position, keyed ``decoding_key(seed, L+i+1)``; Accept [1]
+    int32 — a, the count of leading draft tokens that match
+    (``Tokens[i] == Window[i+1]`` for i < a). The caller emits
+    ``Tokens[0 .. a]`` (a+1 tokens: a accepted drafts — byte-equal to
+    the target's own choices — plus the correction/bonus token), which
+    is exactly the non-speculative trajectory.
+    """
+    logits = ctx.input("Logits")
+    window = ctx.input("Window").reshape(-1)
+    w = window.shape[0]
+    logits = logits.reshape(w, -1)
+    hist = ctx.input("Hist").reshape(())
+    steps = hist.astype(jnp.int32) + 1 + jnp.arange(w, dtype=jnp.int32)
+    if ctx.attr("kind", "greedy") == "sample":
+        seed = jnp.broadcast_to(ctx.input("Seed").reshape(()), (w,))
+        toks = sample_from_logits(
+            logits, seed, steps,
+            temperature=ctx.attr("temperature", 1.0),
+            top_k=ctx.attr("top_k", 0), top_p=ctx.attr("top_p", 1.0))
+    else:
+        toks = jnp.argmax(logits, axis=-1).astype(jnp.int64)
+    match = (toks[:-1] == window[1:]).astype(jnp.int32)
+    accept = jnp.sum(jnp.cumprod(match)).astype(jnp.int32)
+    return {"Tokens": toks, "Accept": accept.reshape(1)}

@@ -12,6 +12,31 @@ from ..core.registry import register_op
 from ..core.framework import convert_dtype
 
 
+def decoding_key(seed, position):
+    """THE decode-side key schedule: ``fold_in(PRNGKey(seed), position)``.
+
+    ``position`` is the 0-based sequence index of the token being
+    generated (the prompt occupies ``[0, n)``, so the first sampled
+    token of an n-token prompt uses position ``n``). Counter-based
+    keying is what makes stochastic decode replayable: the key for
+    position *i* depends only on ``(seed, i)`` — never on which
+    session, process, or fleet member runs the step, nor on how many
+    RNG calls happened before it. A replay that re-prefills an
+    (n+k)-token journal and resumes at position n+k derives exactly
+    the key the fault-free run used.
+
+    Every decode-side sampling site (the ``decode_sample`` /
+    ``decode_verify`` ops, the ``dynamic_beam_search`` sample mode)
+    MUST derive keys through this helper — serving code never touches
+    ``jax.random`` directly (grep-linted in tests/test_decoding.py).
+    Works on traced values: ``seed``/``position`` may be scalars or
+    vmapped array elements.
+    """
+    return jax.random.fold_in(
+        jax.random.PRNGKey(jnp.asarray(seed, jnp.uint32)),
+        jnp.asarray(position, jnp.uint32))
+
+
 @register_op("gaussian_random", needs_rng=True, skip_eval_shape=True)
 def _gaussian_random(ctx):
     shape = tuple(ctx.attr("shape"))

@@ -99,6 +99,18 @@ site                          where / what
                               ``action="callback"`` sleeping past the
                               router's ``call_timeout`` to simulate a
                               wedged member (hang = instant breaker open)
+``decode_draft_mismatch``     GenerationSession speculative verify —
+                              ``index`` is the slot; one firing forces
+                              that slot's round to accept ZERO draft
+                              tokens (worst-case draft disagreement: the
+                              rollback path runs, the output must not
+                              change)
+``decode_constraint_dead_end``GenerationScheduler, after each landed
+                              token of a CONSTRAINED request — ``index``
+                              is the slot; a firing forces the dead-end
+                              verdict, so the request resolves with the
+                              typed :class:`ConstraintDeadEnd` client
+                              error (never a hang, never a replay)
 ============================  =============================================
 
 Actions: ``"raise"`` (raise ``exc``, default :class:`InjectedFault`),

@@ -1,0 +1,22 @@
+"""Decode-policy subsystem: pluggable, journaled "next token".
+
+The serving stack's decode step was a hardcoded argmax. This package
+makes it a :class:`DecodePolicy` — on-device sampling (counter-keyed,
+replayable), speculative decoding (draft + one-pass verify), and
+constrained output (per-state logit masks) — resolved ONCE at session
+construction. The all-defaults flags resolve to ``None``: no policy
+object, no new ops in the programs, byte-identical greedy behavior.
+
+Nothing in this package (or anywhere under ``serving/``) touches
+``jax.random`` — every key derives from
+``ops.random_ops.decoding_key(seed, position)`` inside the device
+programs, which is what makes sampled generations replay
+token-for-token across session faults and fleet failover.
+"""
+
+from .policy import DecodePolicy, mint_seed
+from .constrain import (TokenConstraint, DFAConstraint,
+                        ConstraintDeadEnd)
+
+__all__ = ["DecodePolicy", "mint_seed", "TokenConstraint",
+           "DFAConstraint", "ConstraintDeadEnd"]

@@ -27,12 +27,15 @@ process's death must be an event the fleet absorbs, not an outage.
     tokens-so-far`` per request (workers stream each token back), so
     a killed member's in-flight generations re-drive on a peer by
     re-submitting the journal — exactly the PR-9 replay path, one
-    process up: the peer prefills the history and greedy decoding
-    continues token-for-token identical to a fault-free run. A
-    journal is only reusable on a peer serving the SAME weights
-    version; across versions it is discarded and the generation
-    restarts from the prompt (mixed-version output would be neither
-    version's answer).
+    process up: the peer prefills the history and decoding continues
+    token-for-token identical to a fault-free run (sampled policies
+    included: the router mints the request's decode seed once and
+    re-feeds it on every hop). A journal is only reusable on a peer
+    serving the SAME weights version AND the same decode-policy
+    fingerprint (acked by each member); across either boundary it is
+    discarded and the generation restarts from the prompt
+    (mixed-version — or mixed-policy — output would be neither
+    side's answer).
   - **rolling deploys**: drain one member, ``swap`` it (the worker
     applies the push through the PR-7/PR-9 swap gates), canary-scope
     a fraction of live traffic to it, watch; a watch failure rolls
@@ -72,6 +75,7 @@ single-process serving behavior and hot-path flag-check counts are
 byte-identical with the fleet unused.
 """
 
+import inspect
 import itertools
 import json
 import os
@@ -94,6 +98,7 @@ from ..utils import log as _log
 from . import resilience as _sres
 from . import wire as _wire
 from .batcher import _resolve
+from .decoding.policy import GREEDY_FINGERPRINT, mint_seed
 from .resilience import (ReplicaBreaker, ServingDeadlineError,
                          ServingUnavailableError)
 
@@ -170,7 +175,7 @@ class _VersionRetry(Exception):
 
 class _Member:
     __slots__ = ("id", "addr", "state", "joined_gen", "deadline",
-                 "version", "inflight", "served", "failures",
+                 "version", "policy", "inflight", "served", "failures",
                  "breaker", "conns", "label", "index")
 
     def __init__(self, mid, addr, gen, label, index):
@@ -180,6 +185,7 @@ class _Member:
         self.joined_gen = gen
         self.deadline = None  # monotonic heartbeat deadline
         self.version = None   # last weights tag the member reported
+        self.policy = None    # last decode-policy fingerprint reported
         self.inflight = 0
         self.served = 0       # completions since the last swap (watch)
         self.failures = 0     # failures since the last swap (watch)
@@ -192,10 +198,12 @@ class _Member:
 class _FleetRequest:
     __slots__ = ("prompt", "tokens", "max_new", "eos_id", "deadline",
                  "future", "meta", "ctx", "replays", "charged",
-                 "failed_on", "canary", "tokens_version", "version",
+                 "failed_on", "canary", "tokens_version",
+                 "tokens_policy", "seed", "version",
                  "version_start", "member", "fail_t", "t_submit")
 
-    def __init__(self, prompt, max_new, eos_id, deadline, meta):
+    def __init__(self, prompt, max_new, eos_id, deadline, meta,
+                 seed=0):
         self.prompt = [int(t) for t in prompt]
         self.tokens = []          # the replay journal's generated half
         self.max_new = max_new
@@ -209,6 +217,8 @@ class _FleetRequest:
         self.failed_on = set()    # member ids this request failed on
         self.canary = None        # pinned canary routing for one hop
         self.tokens_version = None  # weights tag that produced tokens
+        self.tokens_policy = None   # decode-policy fp that produced them
+        self.seed = int(seed)     # minted ONCE; re-fed on every replay
         self.version = None
         self.version_start = None
         self.member = None
@@ -594,12 +604,16 @@ class FleetRouter:
 
     # -- request plane ----------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_ms=None, meta=False):
+               deadline_ms=None, meta=False, seed=None):
         """Route one generation request over the fleet; returns a
         Future of the generated ids (int64 array), or — with
         ``meta=True`` — of ``{"tokens", "version", "version_start",
         "member", "replays"}`` (the deploy-proof surface: a response
-        is served by exactly one weights version)."""
+        is served by exactly one weights version). ``seed`` keys a
+        sampled decode policy on the members; minted here when None —
+        ALWAYS, because the router cannot know which policy members
+        run, and an unseeded sampled journal could never re-drive
+        bit-identically after a member death."""
         if self._closed:
             raise RuntimeError("router is closed")
         prompt = np.asarray(prompt, np.int64).reshape(-1)
@@ -615,7 +629,8 @@ class FleetRouter:
                     % float(deadline_ms))
             deadline = time.monotonic() + budget
         req = _FleetRequest(prompt, max_new_tokens, eos_id, deadline,
-                            meta)
+                            meta,
+                            seed=mint_seed() if seed is None else seed)
         req.ctx = _rtrace.mint("fleet.submit",
                                prompt_len=int(prompt.size),
                                router=self._rid)
@@ -831,6 +846,21 @@ class FleetRouter:
                                   to_version=m.version,
                                   discarded=len(req.tokens))
                 req.tokens = []
+            if req.tokens and m.policy is not None and \
+                    req.tokens_policy != m.policy:
+                # same rule, decode semantics instead of weights: a
+                # journal minted under one decode policy must never
+                # resume under another (a greedy prefix spliced onto
+                # a sampled continuation is neither policy's answer).
+                # m.policy None = member never acked yet; the ack
+                # recheck below covers that hop.
+                _JOURNAL_RESETS.inc()
+                if req.ctx is not None:
+                    _rtrace.event(req.ctx, "journalReset",
+                                  from_policy=req.tokens_policy,
+                                  to_policy=m.policy,
+                                  discarded=len(req.tokens))
+                req.tokens = []
             gen_at_dispatch = self._generation
             hop_span = None
             if req.ctx is not None:
@@ -856,6 +886,7 @@ class FleetRouter:
                            "prompt": req.journal(),
                            "max_new": req.remaining(),
                            "eos_id": req.eos_id,
+                           "seed": req.seed,
                            "deadline_ms": remaining_ms,
                            "trace_id": None if req.ctx is None
                            else req.ctx.trace_id})
@@ -872,12 +903,33 @@ class FleetRouter:
                         # STARTS under; the done frame must match it
                         # — the exactly-one-version proof surface
                         ack_version = msg.get("version")
+                        ack_policy = msg.get("policy",
+                                             GREEDY_FINGERPRINT)
                         req.version_start = ack_version
                         if req.eos_id is None and \
                                 msg.get("eos_id") is not None:
                             req.eos_id = int(msg["eos_id"])
                         with self._lock:
                             m.version = ack_version or m.version
+                            m.policy = ack_policy or m.policy
+                        if req.tokens and \
+                                req.tokens_policy != ack_policy:
+                            # the authoritative decode-policy check:
+                            # the cached check above can miss a
+                            # member whose policy the router never
+                            # learned (fresh join, restart). Same
+                            # abandon-and-retry as a version skew —
+                            # no spliced-policy response, ever.
+                            _JOURNAL_RESETS.inc()
+                            if req.ctx is not None:
+                                _rtrace.event(
+                                    req.ctx, "journalReset",
+                                    from_policy=req.tokens_policy,
+                                    to_policy=ack_policy,
+                                    discarded=len(req.tokens),
+                                    at="ack")
+                            del req.tokens[:]
+                            raise _VersionRetry()
                         if req.tokens and \
                                 req.tokens_version != ack_version:
                             # the pre-hop check used the router's
@@ -914,6 +966,7 @@ class FleetRouter:
                             req.fail_t = None
                         req.tokens.append(int(msg["t"]))
                         req.tokens_version = m.version
+                        req.tokens_policy = m.policy
                     elif ev == "done":
                         with self._lock:
                             fenced = m.state == "dead"
@@ -940,6 +993,7 @@ class FleetRouter:
                         req.version = msg.get("version", m.version)
                         req.member = m.id
                         req.tokens_version = req.version
+                        req.tokens_policy = m.policy
                         with self._lock:
                             m.served += 1
                             m.version = req.version
@@ -1273,6 +1327,21 @@ class EngineWorker:
         self.backend = backend
         self._kind = ("generation" if hasattr(backend, "sessions")
                       else "engine")
+        # the decode-policy fingerprint this member acks with: the
+        # router gates journal reuse on it exactly as it gates on the
+        # weights version. Computed once — the policy is immutable
+        # for the scheduler's lifetime.
+        fp = getattr(backend, "policy_fingerprint", None)
+        self._policy_fp = fp() if callable(fp) else GREEDY_FINGERPRINT
+        # seed forwarding is signature-gated: the router mints a seed
+        # on EVERY request (it can't know member policies), but a
+        # backend whose submit() predates decode policies (engines,
+        # test fakes) must keep working untouched.
+        try:
+            self._accepts_seed = "seed" in inspect.signature(
+                backend.submit).parameters
+        except (TypeError, ValueError, AttributeError):
+            self._accepts_seed = False
         if self._kind == "engine":
             # the pre-deploy artifact dir IS the first swap's
             # rollback target — without it a failed first push has
@@ -1426,16 +1495,23 @@ class EngineWorker:
             eos_id = int(self.backend.sessions[0].spec.eos_id)
         conn.send({"ev": "ack", "member": self.member_id,
                    "pid": os.getpid(), "version": self.version,
+                   "policy": self._policy_fp,
                    "eos_id": int(eos_id)})
         tokq = queue.Queue()
         version_start = self.version
+        kw = {}
+        if self._accepts_seed and msg.get("seed") is not None:
+            # the router-minted decode seed: re-fed verbatim on every
+            # replay hop so a sampled generation resumes its exact
+            # key schedule
+            kw["seed"] = int(msg["seed"])
         try:
             with _rtrace.activate(ctx):
                 fut = self.backend.submit(
                     msg["prompt"], max_new_tokens=msg.get("max_new"),
                     eos_id=msg.get("eos_id"),
                     deadline_ms=msg.get("deadline_ms"),
-                    on_token=tokq.put)
+                    on_token=tokq.put, **kw)
         except ServingDeadlineError as exc:
             conn.send({"ev": "err", "kind": "deadline",
                        "error": repr(exc)[:300]})

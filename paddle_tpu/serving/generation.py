@@ -92,7 +92,22 @@ Shed/deadline events share the serving counters
 (``paddle_serving_shed_total`` / ``_deadline_exceeded_total``).
 Fault sites: ``generation_step_fail`` (persistent with
 ``times=None``), ``generation_admit_fail``,
-``generation_session_wedge`` — all indexed by session.
+``generation_session_wedge`` — all indexed by session — plus the
+decode-policy sites ``decode_draft_mismatch`` (force a full-reject
+speculative round) and ``decode_constraint_dead_end`` (force the
+typed dead-end client error), both indexed by slot.
+
+Decode policies (PR 17, ``serving/decoding``): a session whose spec
+carries a :class:`~paddle_tpu.serving.decoding.DecodePolicy` samples
+on device under counter-based keys (``decoding_key(seed, position)``
+— the seed is minted per request at the front door, carried in the
+replay journal, and re-fed on every replay, so SAMPLED output is as
+bit-replayable as greedy), optionally speculates with a draft
+session (k drafts verified in ONE paged suffix-window forward,
+rejected rows rolled back via the COW block machinery), and
+optionally constrains output with host-compiled additive logit
+masks. All of it is construction-gated: no policy, no new feeds, no
+new programs — the default dispatcher path is byte-identical.
 """
 
 import collections
@@ -117,6 +132,7 @@ from ..resilience import faults as _faults
 from ..utils import log as _log
 from . import resilience as _sres
 from .batcher import ServingOverloadError, _resolve, _WAIT_ALPHA
+from .decoding.policy import GREEDY_FINGERPRINT, mint_seed
 from .resilience import (ReplicaBreaker, ServingDeadlineError,
                          ServingUnavailableError)
 
@@ -172,6 +188,13 @@ _RECOVERY_SECONDS = _metrics.REGISTRY.histogram(
     "paddle_generation_failover_recovery_seconds",
     "Session failure -> the replayed request decoding again on a "
     "healthy session (re-queue wait + replay prefill)")
+_SPEC_DRAFTED = _metrics.REGISTRY.counter(
+    "paddle_generation_speculative_drafted_total",
+    "Draft tokens proposed by speculative-decoding rounds")
+_SPEC_ACCEPTED = _metrics.REGISTRY.counter(
+    "paddle_generation_speculative_accepted_total",
+    "Draft tokens accepted by the target's verify pass (the ratio to "
+    "_drafted_total is the speculative accept rate)")
 
 _STOP = object()
 
@@ -263,7 +286,9 @@ class GenerationSpec:
                  "prefill_feeds", "prefill_fetch", "decode_program",
                  "decode_feeds", "decode_fetch", "rebuild", "paged",
                  "block_size", "num_blocks", "max_blocks",
-                 "prefix_cache", "copy_program", "copy_feeds")
+                 "prefix_cache", "copy_program", "copy_feeds",
+                 "vocab_size", "policy", "verify_program",
+                 "verify_feeds", "verify_fetch", "draft_spec")
 
     def __init__(self, **kwargs):
         kwargs.setdefault("rebuild", None)
@@ -274,6 +299,15 @@ class GenerationSpec:
         kwargs.setdefault("prefix_cache", False)
         kwargs.setdefault("copy_program", None)
         kwargs.setdefault("copy_feeds", None)
+        # decode-policy surface (serving/decoding): all None/0 when
+        # the decode_* flags sit at their defaults, so every PR-8..16
+        # spec construction and pickle stays valid unchanged
+        kwargs.setdefault("vocab_size", 0)
+        kwargs.setdefault("policy", None)
+        kwargs.setdefault("verify_program", None)
+        kwargs.setdefault("verify_feeds", None)
+        kwargs.setdefault("verify_fetch", None)
+        kwargs.setdefault("draft_spec", None)
         for name in self.__slots__:
             setattr(self, name, kwargs.pop(name))
         if kwargs:
@@ -296,7 +330,7 @@ class GenerationSession:
     proof, asserted in tests and printed by tools/generate_probe.py.
     """
 
-    def __init__(self, spec, scope=None, place=None):
+    def __init__(self, spec, scope=None, place=None, draft_scope=None):
         import jax.numpy as jnp
         self.spec = spec
         self.scope = scope if scope is not None else global_scope()
@@ -344,6 +378,31 @@ class GenerationSession:
             # bounded (see _admit_paged) so a long-lived session
             # doesn't accumulate host memory per admission
             self.prefill_log = []
+        # -- decode-policy state (spec.policy; serving/decoding) -------
+        policy = getattr(spec, "policy", None)
+        self.policy = policy
+        self.sampled = policy is not None and policy.sampled
+        self.constrained = policy is not None and \
+            policy.constraint is not None
+        self.speculative = policy is not None and policy.speculate_k > 0
+        # per-slot request seed / constraint-automaton state, set at
+        # admission, journal-recomputable (the replay contract)
+        self.seeds = np.zeros(n, np.int64)
+        self.cstate = [None] * n
+        self._mask_table = None
+        if self.constrained:
+            self._mask_table = policy.constraint.mask_table(
+                spec.vocab_size)
+        self.draft = None
+        if self.speculative:
+            # the draft mirrors the target slot-for-slot: admitted,
+            # advanced, and retired in lockstep. Default drafts share
+            # the target's scope (parameter-name truncation = free
+            # self-draft); dim-changed drafts need their own scope.
+            self.draft = GenerationSession(
+                spec.draft_spec,
+                scope=self.scope if draft_scope is None else draft_scope,
+                place=place)
 
     # -- slot bookkeeping ------------------------------------------------
     def free_slots(self):
@@ -520,6 +579,9 @@ class GenerationSession:
         rebuild path, which closes the old session on hand-over) can
         never leak a block. Idempotent; the session must not be
         stepped after."""
+        if self.draft is not None:
+            self.draft.close()
+            self.draft = None
         if self.paged and self.pool is not None:
             for slot in range(self.spec.slots):
                 self._release_table(slot)
@@ -540,8 +602,52 @@ class GenerationSession:
         self._claimed = set()
         self.active[:] = False
 
+    # -- decode-policy plumbing ------------------------------------------
+    def _policy_prefill_feed(self, feed, n, seed, cstate):
+        """Append the decode-policy feeds to a prefill feed dict.
+        ``n`` is the TOTAL history length — the sequence index of the
+        token this prefill emits, i.e. the counter in decoding_key —
+        so a replay prefilling prompt+journal lands on the exact key
+        the original decode used at that position."""
+        if self.sampled:
+            feed["gen.pseed"] = np.asarray([seed], np.int64)
+            feed["gen.pstep"] = np.asarray([n], np.int32)
+        if self.constrained:
+            c = self.policy.constraint
+            state = c.start if cstate is None else cstate
+            feed["gen.pmask"] = self._mask_table[
+                c.state_index(state)].reshape(1, -1)
+
+    def _policy_admitted(self, slot, first, seed, cstate):
+        """Record per-slot policy state once an admission emitted its
+        first token, and mirror the admission into the draft."""
+        self.seeds[slot] = int(seed)
+        if self.constrained:
+            c = self.policy.constraint
+            state = c.start if cstate is None else cstate
+            self.cstate[slot] = c.advance(state, int(first))
+
+    def _draft_admit(self, prompt, slot, first):
+        """Mirror an admission into the draft session (same slot by
+        lockstep construction), then pin its pending token to the
+        TARGET's emission — the draft guesses continuations of the
+        target's trajectory, never its own."""
+        if self.draft is None:
+            return
+        try:
+            dslot, _ = self.draft.admit(prompt)
+        except BaseException:
+            self.retire(slot)
+            raise
+        if dslot != slot:
+            self.retire(slot)
+            raise RuntimeError(
+                "draft session desynchronized: target slot %d, draft "
+                "slot %d" % (slot, dslot))
+        self.draft.last_token[slot] = int(first)
+
     # -- execution -------------------------------------------------------
-    def admit(self, prompt):
+    def admit(self, prompt, seed=0, cstate=None):
         """Prefill ``prompt`` (1-D int ids) into a free slot: the
         prompt's K/V rows land in the cache, the slot becomes active,
         and the first greedy token is returned as ``(slot, token)``.
@@ -559,7 +665,7 @@ class GenerationSession:
         if n < 1:
             raise ValueError("empty prompt")
         if self.paged:
-            return self._admit_paged(prompt)
+            return self._admit_paged(prompt, seed, cstate)
         bucket = self.prompt_bucket(n)
         if bucket is None:
             raise ValueError(
@@ -572,23 +678,26 @@ class GenerationSession:
         slot = free[0]
         padded = np.full((1, bucket), self.spec.eos_id, np.int64)
         padded[0, :n] = prompt
-        f_tok, f_len, f_pos, f_slot = self.spec.prefill_feeds
+        f_tok, f_len, f_pos, f_slot = self.spec.prefill_feeds[:4]
+        feed = {f_tok: padded,
+                f_len: np.asarray([n], np.int32),
+                f_pos: np.asarray([n - 1], np.int32),
+                f_slot: np.asarray([slot], np.int32)}
+        self._policy_prefill_feed(feed, n, seed, cstate)
         with _tracing.span("generationPrefill", bucket=bucket):
             outs = self.exe.run(
-                self.spec.prefill_programs[bucket],
-                feed={f_tok: padded,
-                      f_len: np.asarray([n], np.int32),
-                      f_pos: np.asarray([n - 1], np.int32),
-                      f_slot: np.asarray([slot], np.int32)},
+                self.spec.prefill_programs[bucket], feed=feed,
                 fetch_list=[self.spec.prefill_fetch], scope=self.scope)
         first = int(np.asarray(outs[0]).reshape(-1)[0])
         self.lengths[slot] = n
         self.last_token[slot] = first
         self.active[slot] = True
+        self._policy_admitted(slot, first, seed, cstate)
+        self._draft_admit(prompt, slot, first)
         _PREFILLS.labels(bucket=bucket).inc()
         return slot, first
 
-    def _admit_paged(self, prompt):
+    def _admit_paged(self, prompt, seed=0, cstate=None):
         """Paged admission: match the cached prefix, reference its
         blocks, allocate fresh ones for the rest, prefill ONLY the
         unshared suffix window, then register the prompt's blocks in
@@ -637,17 +746,20 @@ class GenerationSession:
                           np.int32)
             tab[:len(table)] = table
             f_tok, f_len, f_pos, f_hist, f_pix, f_tab = \
-                self.spec.prefill_feeds
+                self.spec.prefill_feeds[:6]
+            feed = {f_tok: padded,
+                    f_len: np.asarray([w], np.int32),
+                    f_pos: np.asarray([w - 1], np.int32),
+                    f_hist: np.asarray([matched], np.int32),
+                    f_pix: pix,
+                    f_tab: tab}
+            # the emitted token's index is the TOTAL length n
+            # (= matched + w), prefix sharing included
+            self._policy_prefill_feed(feed, n, seed, cstate)
             with _tracing.span("generationPrefill", bucket=bucket,
                                hist=matched):
                 outs = self.exe.run(
-                    self.spec.prefill_programs[bucket],
-                    feed={f_tok: padded,
-                          f_len: np.asarray([w], np.int32),
-                          f_pos: np.asarray([w - 1], np.int32),
-                          f_hist: np.asarray([matched], np.int32),
-                          f_pix: pix,
-                          f_tab: tab},
+                    self.spec.prefill_programs[bucket], feed=feed,
                     fetch_list=[self.spec.prefill_fetch],
                     scope=self.scope)
         except BaseException:
@@ -664,6 +776,8 @@ class GenerationSession:
         self.lengths[slot] = n
         self.last_token[slot] = first
         self.active[slot] = True
+        self._policy_admitted(slot, first, seed, cstate)
+        self._draft_admit(prompt, slot, first)
         self._starved.discard(slot)
         self.prefill_log.append((bucket, matched, w))
         if len(self.prefill_log) > 4096:     # keep a list (tests
@@ -728,12 +842,37 @@ class GenerationSession:
             raise RuntimeError(
                 "slots %s are at cache capacity %d — retire before "
                 "stepping" % (over, self.max_pos))
+        if self.speculative:
+            W = self.policy.speculate_k + 1
+            if all(self.capacity_left(int(s)) >= W for s in act):
+                return self._prepare_spec(act)
+            # near capacity: a window write would overrun the cache —
+            # fall back to plain single-token rounds, which finish
+            # these slots (speculation resumes once they retire)
         if self.paged:
             return self._prepare_paged(act)
-        f_tok, f_pos = self.spec.decode_feeds
+        f_tok, f_pos = self.spec.decode_feeds[:2]
         feed = {f_tok: self.last_token.reshape(-1, 1).copy(),
                 f_pos: self.lengths.astype(np.int32)}
+        self._policy_decode_feed(feed)
         return (act, frozenset(), feed)
+
+    def _policy_decode_feed(self, feed):
+        """Append the decode-policy feeds to a decode-step feed dict.
+        Step = lengths + 1: a slot at length L emits the token at
+        sequence index L+1 — its decoding_key counter."""
+        if self.sampled:
+            feed["gen.dseed"] = self.seeds.copy()
+            feed["gen.dstep"] = (self.lengths + 1).astype(np.int32)
+        if self.constrained:
+            c = self.policy.constraint
+            mask = np.zeros((self.spec.slots, self.spec.vocab_size),
+                            np.float32)
+            for s in np.flatnonzero(self.active):
+                state = self.cstate[int(s)]
+                if state is not None:
+                    mask[int(s)] = self._mask_table[c.state_index(state)]
+            feed["gen.dmask"] = mask
 
     def _prepare_paged(self, act):
         """Paged phase 1: grow/copy-on-write each active slot's write
@@ -765,17 +904,57 @@ class GenerationSession:
                 continue
             tbl = self.tables[s]
             tab[s, :len(tbl)] = tbl
-        f_tok, f_pos, f_tab = self.spec.decode_feeds
+        f_tok, f_pos, f_tab = self.spec.decode_feeds[:3]
         feed = {f_tok: self.last_token.reshape(-1, 1).copy(),
                 f_pos: self.lengths.astype(np.int32),
                 f_tab: tab}
+        self._policy_decode_feed(feed)
         return (act, frozenset(self._starved), feed)
+
+    def _prepare_spec(self, act):
+        """Speculative phase 1: extend each active slot's block table
+        to cover the verify-window rows [L, L+W) — block growth and
+        copy-on-write only, on the dispatcher thread (step_prepare's
+        allocator contract). A slot the pool cannot cover is starved
+        out of the round exactly like plain paged starvation, its
+        this-round growth returned."""
+        from .paged_cache import PoolExhausted
+        bs = self.spec.block_size
+        W = self.policy.speculate_k + 1
+        self._starved.clear()
+        info = {}
+        for s in act:
+            s = int(s)
+            L = int(self.lengths[s])
+            tbl = self.tables[s]
+            held = len(tbl)
+            need = (L + W - 1) // bs + 1
+            try:
+                for bi in range(L // bs, min(held, need)):
+                    self._ensure_writable(tbl, bi)
+                while len(tbl) < need:
+                    tbl.append(self._alloc_block())
+            except PoolExhausted:
+                self.pool.truncate_table(tbl, held)
+                self._starved.add(s)
+                continue
+            tab = np.full(self.spec.max_blocks, self.pool.num_blocks,
+                          np.int32)
+            tab[:len(tbl)] = tbl
+            info[s] = (L, tab)
+        return {"slots": info, "starved": frozenset(self._starved)}
 
     def step_run(self, prepared):
         """Phase 2 of a decode step: the device call plus result
         application. Touches no allocator state — safe to execute on
         the scheduler's bounded (leakable) worker thread; the feeds
-        and starved-set were snapshotted at prepare time."""
+        and starved-set were snapshotted at prepare time. (The
+        speculative round is the one exception: it runs drafting,
+        verification AND pool rollback here, which is why the
+        scheduler refuses step_timeout_ms on speculative sessions —
+        that round only ever executes inline on the dispatcher.)"""
+        if isinstance(prepared, dict):
+            return self._step_run_spec(prepared)
         act, starved, feed = prepared
         with _tracing.span("generationStep",
                            active=int(act.size)):
@@ -791,6 +970,105 @@ class GenerationSession:
             self.lengths[s] += 1
             self.last_token[s] = int(nxt[s])
             result[s] = int(nxt[s])
+            if self.constrained:
+                self.cstate[s] = self.policy.constraint.advance(
+                    self.cstate[s], int(nxt[s]))
+        if self.draft is not None and result:
+            self._draft_mirror_plain(result)
+        return result
+
+    def _draft_mirror_plain(self, result):
+        """A plain single-token round under a speculative session (the
+        near-capacity fallback): the draft must still append the
+        pending token's K/V row to stay coherent, so step it once —
+        its own emission is discarded — and pin its pending token to
+        the target's."""
+        self.draft.step()
+        for s in self.draft.active_slots():
+            if s in result:
+                self.draft.last_token[s] = result[s]
+            else:
+                # target starved this slot while the draft advanced:
+                # mirror the target's (unchanged) state back
+                self.draft.lengths[s] = int(self.lengths[s])
+                self.draft.last_token[s] = int(self.last_token[s])
+
+    def _step_run_spec(self, prepared):
+        """Speculative phase 2: k+1 batched greedy draft steps, then
+        per-slot one-pass verification against the TARGET's policy,
+        multi-token application, and block rollback. Returns
+        {slot: [token, ...]} — each list is the accepted draft prefix
+        plus the target's correction/bonus token, so it is exactly
+        the tokens plain rounds would have emitted one at a time."""
+        from .paged_cache import SPEC_ROLLBACKS
+        info = prepared["slots"]
+        starved = prepared["starved"]
+        k = self.policy.speculate_k
+        W = k + 1
+        bs = self.spec.block_size
+        # snapshot draft pendings: starved slots sit the round out on
+        # the target but the batched draft advances them anyway
+        restore = {s: (int(self.draft.lengths[s]),
+                       int(self.draft.last_token[s]))
+                   for s in starved}
+        # phase A: k proposals per slot, plus one extra step so the
+        # draft's cache holds a K/V row for EVERY window position a
+        # full acceptance confirms (the bonus-token row)
+        drafts = {s: [] for s in info}
+        for i in range(W):
+            out = self.draft.step()
+            if i < k:
+                for s in drafts:
+                    drafts[s].append(out[s])
+        # phase B: one suffix-window forward per speculating slot
+        vtok, vlen, vhist, vpix, vtab, vseed = self.spec.verify_feeds
+        result = {}
+        for s, (L, tab) in sorted(info.items()):
+            window = np.empty((1, W), np.int64)
+            window[0, 0] = self.last_token[s]
+            window[0, 1:] = drafts[s]
+            pix = np.clip(L + np.arange(W), 0,
+                          self.spec.max_len - 1).astype(np.int32)
+            with _tracing.span("generationVerify", window=W):
+                outs = self.exe.run(
+                    self.spec.verify_program,
+                    feed={vtok: window,
+                          vlen: np.asarray([W], np.int32),
+                          vhist: np.asarray([L], np.int32),
+                          vpix: pix,
+                          vtab: tab,
+                          vseed: np.asarray([self.seeds[s]],
+                                            np.int64)},
+                    fetch_list=list(self.spec.verify_fetch),
+                    scope=self.scope)
+            toks = np.asarray(outs[0]).reshape(-1)
+            accept = int(np.asarray(outs[1]).reshape(-1)[0])
+            if _faults.should_fire("decode_draft_mismatch",
+                                   index=s) is not None:
+                accept = 0   # chaos hook: force a full-reject round
+            _SPEC_DRAFTED.inc(k)
+            _SPEC_ACCEPTED.inc(accept)
+            emitted = [int(t) for t in toks[:accept + 1]]
+            new_len = L + accept + 1
+            self.lengths[s] = new_len
+            self.last_token[s] = emitted[-1]
+            # roll back window rows past the confirmed prefix: blocks
+            # beyond the new length decref (prepare's COW already
+            # diverged every shared write block, so sharers are safe);
+            # surviving garbage rows sit beyond the length mask and
+            # are overwritten in place by later writes
+            freed = self.pool.truncate_table(
+                self.tables[s], (new_len - 1) // bs + 1)
+            if freed:
+                SPEC_ROLLBACKS.inc(freed)
+            # draft rollback is a length truncation: its rows live at
+            # fixed positions, so rejected rows are simply overwritten
+            self.draft.lengths[s] = new_len
+            self.draft.last_token[s] = emitted[-1]
+            result[s] = emitted
+        for s, (dl, dt) in restore.items():
+            self.draft.lengths[s] = dl
+            self.draft.last_token[s] = dt
         return result
 
     def retire(self, slot):
@@ -803,16 +1081,22 @@ class GenerationSession:
         self.active[slot] = False
         self.lengths[slot] = 0
         self.last_token[slot] = 0
+        self.seeds[slot] = 0
+        self.cstate[slot] = None
+        if self.draft is not None:
+            self.draft.retire(slot)
         if self.paged:
             self._release_table(slot)
             self._starved.discard(slot)
 
-    def generate(self, prompt, max_new_tokens=None, eos_id=None):
+    def generate(self, prompt, max_new_tokens=None, eos_id=None,
+                 seed=0):
         """Synchronous single-sequence convenience (tests/probes): the
-        greedy continuation of ``prompt``, stopping at ``eos_id`` or
-        ``max_new_tokens``, as a list of ids (EOS excluded)."""
+        policy continuation of ``prompt`` (greedy by default),
+        stopping at ``eos_id`` or ``max_new_tokens``, as a list of ids
+        (EOS excluded). ``seed`` keys sampled policies."""
         eos = self.spec.eos_id if eos_id is None else eos_id
-        slot, first = self.admit(prompt)
+        slot, first = self.admit(prompt, seed=seed)
         # prefill already produced one token; each further step can
         # write one more K/V row, so cap+1 tokens total fit the slot
         cap = self.capacity_left(slot)
@@ -824,7 +1108,14 @@ class GenerationSession:
                 nxt = self.step()
                 if slot not in nxt:
                     break  # paged pool exhausted: finish at length
-                tokens.append(nxt[slot])
+                got = nxt[slot]
+                # speculative rounds emit a LIST per slot; tokens past
+                # EOS or the budget are discarded (the round could not
+                # know the sequence would end mid-window)
+                for t in (got if isinstance(got, list) else [got]):
+                    tokens.append(t)
+                    if t == eos or len(tokens) >= limit:
+                        break
         finally:
             self.retire(slot)
         if tokens and tokens[-1] == eos:
@@ -837,11 +1128,16 @@ class _GenRequest:
                  "future", "deadline", "t_submit", "tokens", "slot",
                  "session_index", "t_last", "t_queued", "replays",
                  "charged", "failed_on", "last_exc", "ctx",
-                 "on_token")
+                 "on_token", "seed")
 
     def __init__(self, prompt, max_new, explicit_budget, eos_id,
-                 deadline, on_token=None):
+                 deadline, on_token=None, seed=0):
         self.prompt = prompt
+        # the request's decode-RNG seed: minted ONCE at the front
+        # door, re-fed on every replay admission — together with the
+        # prompt+tokens journal it makes SAMPLED decode exactly as
+        # replayable as greedy (serving/decoding)
+        self.seed = seed
         self.max_new = max_new
         # True when the CALLER asked for max_new tokens (placement
         # must find a session able to serve them all); False when the
@@ -963,6 +1259,19 @@ class GenerationScheduler:
         if not sessions:
             raise ValueError("need at least one GenerationSession")
         self.sessions = list(sessions)
+        # every session must make the SAME next-token decisions: a
+        # replay journal only resumes bit-identically where the
+        # decode policy is identical (the weights-version rule of the
+        # fleet tier, applied inside one scheduler)
+        fps = {(s.policy.fingerprint() if s.policy is not None
+                else GREEDY_FINGERPRINT) for s in self.sessions}
+        if len(fps) > 1:
+            raise ValueError(
+                "sessions disagree on decode policy (%s) — a replay "
+                "journal is only re-drivable across sessions that "
+                "make identical next-token decisions" % sorted(fps))
+        self._policy_fp = fps.pop()
+        self._sampled = any(s.sampled for s in self.sessions)
         self._q = queue.Queue(maxsize=max_queue)
         # dispatcher-local order-preserving buffer: items parked when
         # no slot is free right now, and re-queue overflow from the
@@ -1014,6 +1323,14 @@ class GenerationScheduler:
                 "generation_step_timeout_ms")
         self.step_timeout = (float(step_timeout_ms) / 1e3
                              if step_timeout_ms else None)
+        if self.step_timeout is not None and \
+                any(s.speculative for s in self.sessions):
+            raise ValueError(
+                "step_timeout_ms does not compose with speculative "
+                "decoding: the speculative round mutates the block "
+                "pool inside step_run, which must stay on the "
+                "dispatcher thread — a leaked bounded worker could "
+                "race retire()/close() on the allocator books")
         self._wedged = {}        # si -> done-Event of the leaked step
         self._rebuilding = set()  # session indices down for rebuild
         # True only once NOTHING will absorb rebuilds anymore (the
@@ -1057,9 +1374,16 @@ class GenerationScheduler:
             return ["closed"] * len(self.sessions)
         return [b.state for b in self._breakers]
 
+    def policy_fingerprint(self):
+        """The decode-policy fingerprint every session here shares
+        (``"greedy"`` with no policy) — what the fleet worker acks so
+        the router can gate journal reuse (serving/fleet.py)."""
+        return self._policy_fp
+
     # -- admission -------------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_ms=None, timeout=None, on_token=None):
+               deadline_ms=None, timeout=None, on_token=None,
+               seed=None):
         """Enqueue one prompt; returns a Future of its generated ids.
 
         ``max_new_tokens`` is capped by the slot capacity left after
@@ -1070,7 +1394,11 @@ class GenerationScheduler:
         queue before :class:`ServingOverloadError`. ``on_token``:
         optional observer called with each newly generated token on
         the dispatcher thread (the fleet tier's streaming hook —
-        default None costs one attribute check per token)."""
+        default None costs one attribute check per token). ``seed``:
+        the request's decode-RNG seed under a sampled policy — minted
+        fresh when None, pass one explicitly to reproduce a sampled
+        generation exactly (the fleet router does, so every failover
+        hop resumes the same trajectory)."""
         if self._closed:
             raise RuntimeError("scheduler is closed")
         prompt = np.asarray(prompt, np.int64).reshape(-1)
@@ -1118,8 +1446,10 @@ class GenerationScheduler:
                     "the %.1f ms deadline budget"
                     % (projected * 1e3, budget * 1e3))
             deadline = time.monotonic() + budget
+        if seed is None:
+            seed = mint_seed() if self._sampled else 0
         item = _GenRequest(prompt, max_new, explicit, eos_id, deadline,
-                           on_token=on_token)
+                           on_token=on_token, seed=int(seed))
         # minted at the front door (one attribute read when off),
         # carried on the item/journal through every queue, session,
         # and replay hop
@@ -1406,7 +1736,15 @@ class GenerationScheduler:
             # spans land on this request's trace)
             with _rtrace.activate(item.ctx):
                 _faults.fire_point("generation_admit_fail", index=si)
-                slot, first = sess.admit(item.history())
+                cstate = None
+                if sess.constrained:
+                    # replay state folds the journal through the
+                    # automaton — the host state is journal-derived,
+                    # exactly like the KV cache
+                    c = sess.policy.constraint
+                    cstate = c.advance_many(c.start, item.tokens)
+                slot, first = sess.admit(item.history(),
+                                         seed=item.seed, cstate=cstate)
         except ValueError as exc:
             # a client-shaped prompt (bucket/length) is the request's
             # fault, not the session's — it must not charge the
@@ -1455,7 +1793,10 @@ class GenerationScheduler:
         item.notify_token(first)
         self._active[(si, slot)] = item
         self._update_occupancy()
-        self._finish_if_done(item)  # EOS/budget can end it at token 1
+        # EOS/budget can end it at token 1; a surviving constrained
+        # request may already be in a dead automaton state
+        if not self._finish_if_done(item):
+            self._check_dead_end(sess, item)
 
     def _on_admit_failure(self, item, si, exc):
         """A session failed this request's (re-)admission: charge its
@@ -1561,6 +1902,33 @@ class GenerationScheduler:
                               dur_ms=e2e * 1e3)
             _resolve(item.future,
                      result=np.asarray(item.tokens, np.int64))
+        self._update_occupancy()
+        return True
+
+    def _check_dead_end(self, sess, item):
+        """Constraint dead end: the automaton state a just-landed
+        token advanced into bans EVERY next token. Resolved as a
+        typed CLIENT error — no breaker charge, no replay, and above
+        all no hang (an all--inf mask row would otherwise argmax
+        garbage forever). The ``decode_constraint_dead_end`` fault
+        site forces this path for chaos tests. Returns True when the
+        request left its slot."""
+        if not sess.constrained:
+            return False
+        key = (item.session_index, item.slot)
+        if key not in self._active:
+            return False
+        state = sess.cstate[item.slot]
+        fired = _faults.should_fire("decode_constraint_dead_end",
+                                    index=item.slot)
+        if fired is None and not sess.policy.constraint.dead(state):
+            return False
+        sess.retire(item.slot)
+        del self._active[key]
+        _RETIRED.labels(reason="dead_end").inc()
+        from .decoding import ConstraintDeadEnd
+        self._resolve_err(
+            item, ConstraintDeadEnd(state, len(item.tokens)))
         self._update_occupancy()
         return True
 
@@ -1749,17 +2117,27 @@ class GenerationScheduler:
                     _resolve(it.future,
                              result=np.asarray(it.tokens, np.int64))
                     continue
-                advanced += 1
-                it.tokens.append(toks[slot])
-                it.notify_token(toks[slot])
-                _INTER_TOKEN_SECONDS.observe(now_pc - it.t_last)
-                it.t_last = now_pc
-                if it.ctx is not None:
-                    _rtrace.event(it.ctx, "decodeStep",
-                                  dur_ms=step_ms, session=si,
-                                  slot=slot, active=len(mine),
-                                  token_index=len(it.tokens))
-                self._finish_if_done(it)
+                got = toks[slot]
+                # a speculative round emits a LIST per slot — the
+                # accepted draft prefix plus the correction/bonus
+                # token; plain rounds stay a bare int
+                for tok in (got if isinstance(got, list) else [got]):
+                    advanced += 1
+                    it.tokens.append(tok)
+                    it.notify_token(tok)
+                    _INTER_TOKEN_SECONDS.observe(now_pc - it.t_last)
+                    it.t_last = now_pc
+                    if it.ctx is not None:
+                        _rtrace.event(it.ctx, "decodeStep",
+                                      dur_ms=step_ms, session=si,
+                                      slot=slot, active=len(mine),
+                                      token_index=len(it.tokens))
+                    if self._finish_if_done(it) or \
+                            self._check_dead_end(sess, it):
+                        # EOS/budget/dead-end mid-window: the round
+                        # could not know — the rest of the list is
+                        # discarded with the slot already retired
+                        break
             _TOKENS.inc(advanced)
 
     # -- session rebuild -------------------------------------------------

@@ -75,6 +75,10 @@ BLOCKS_IN_USE = _metrics.REGISTRY.gauge(
     "Referenced blocks in one session's pool (labelled per pool — "
     "sessions side by side must not overwrite each other)",
     labelnames=("pool",))
+SPEC_ROLLBACKS = _metrics.REGISTRY.counter(
+    "paddle_generation_kv_spec_rollback_blocks_total",
+    "Blocks returned by speculative-decoding rollbacks (window rows "
+    "past the accepted draft prefix)")
 
 _POOL_SEQ = itertools.count()
 
@@ -154,6 +158,20 @@ class BlockPool:
             self._update_gauge()
             return True
         return False
+
+    def truncate_table(self, table, n_blocks):
+        """Trim a host block table IN PLACE to its first ``n_blocks``
+        entries, decref'ing the dropped blocks — the speculative-
+        decoding rollback (and the prepare-failure undo): window rows
+        past the accepted prefix return their storage to the pool.
+        Returns how many blocks were dropped."""
+        surplus = table[n_blocks:]
+        if not surplus:
+            return 0
+        del table[n_blocks:]
+        for block in surplus:
+            self.decref(block)
+        return len(surplus)
 
     def close(self):
         """Retire this pool's gauge child (registry label hygiene on

@@ -22,6 +22,13 @@ tier, JSON instead of positional verbs, stdlib sockets only:
   waiting out its full read timeout (the MasterServer.stop lesson —
   every fleet-test teardown would otherwise eat the timeout).
 
+Envelope notes (PR 17): a fleet ``generate`` request carries the
+router-minted decode ``seed`` (re-fed verbatim on every replay hop so
+sampled generations re-drive bit-identically), and each worker ack
+carries the member's decode-policy fingerprint ``policy`` — the router
+gates replay-journal reuse on it exactly as it gates on the weights
+``version``.
+
 Nothing here is constructed by default flags — the module has no
 import-time side effects beyond defining classes.
 """

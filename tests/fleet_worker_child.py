@@ -88,18 +88,32 @@ def model_params(scope, factor=1.0):
     return out
 
 
-def make_scheduler(scope, slots=4, replay_attempts=2, warm=True):
+def make_scheduler(scope, slots=4, replay_attempts=2, warm=True,
+                   decode_policy=None):
     from paddle_tpu.models.transformer import transformer_lm_session
     from paddle_tpu.serving.generation import (GenerationScheduler,
                                                GenerationSession)
 
     spec = transformer_lm_session(
         VOCAB, max_len=MAX_LEN, slots=slots, cache_len=MAX_LEN,
-        prompt_buckets=PROMPT_BUCKETS, bos_id=BOS, eos_id=EOS, **KW)
+        prompt_buckets=PROMPT_BUCKETS, bos_id=BOS, eos_id=EOS,
+        decode_policy=decode_policy, **KW)
     sess = GenerationSession(spec, scope=scope)
     if warm:
         sess.generate([BOS], max_new_tokens=2, eos_id=-1)
     return GenerationScheduler(sess, replay_attempts=replay_attempts)
+
+
+def sampled_policy(temperature=4.0, top_k=0, top_p=1.0):
+    """The one sampled policy the sampled-fleet chaos tests share —
+    parent oracle and child members must agree on every knob, or the
+    fingerprint gate (correctly) resets their journals. Temperature
+    4.0 on purpose: the random-weight child LM has sharply peaked
+    logits, and anything near 1.0 degenerates to argmax — a sampled
+    chaos test that secretly replays greedy proves nothing."""
+    from paddle_tpu.serving.decoding import DecodePolicy
+    return DecodePolicy(kind="sample", temperature=temperature,
+                        top_k=top_k, top_p=top_p)
 
 
 def chaos_prompts(n, seed=0):
@@ -118,6 +132,9 @@ def main():
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--kill-at-token", type=int, default=None)
+    ap.add_argument("--decode-policy", default=None,
+                    choices=(None, "greedy", "sample"))
+    ap.add_argument("--decode-temperature", type=float, default=4.0)
     ap.add_argument("--fail-after-swap", default=None)
     ap.add_argument("--compile-cache", default=None)
     ap.add_argument("--heartbeat-ms", type=float, default=None)
@@ -134,8 +151,12 @@ def main():
         # executables a warm one published — scale-up-to-first-token
         ptpu.config.set_flags(compile_cache_dir=args.compile_cache)
 
+    policy = None
+    if args.decode_policy == "sample":
+        policy = sampled_policy(temperature=args.decode_temperature)
     scope = build_scope(args.seed)
-    sched = make_scheduler(scope, slots=args.slots)
+    sched = make_scheduler(scope, slots=args.slots,
+                           decode_policy=policy)
 
     if args.kill_at_token is not None:
         faults.arm("fleet_member_kill", at=args.kill_at_token,

@@ -913,6 +913,118 @@ class TestFleetChaosSubprocess:
             router.close()
             _stop_children(procs)
 
+    def test_sigkill_under_sampled_decode_bit_identical(self):
+        """ISSUE-17 chaos acceptance, fleet half: 3 SAMPLED members,
+        explicit per-request seeds, SIGKILL one mid-decode — zero
+        client-visible errors and every output bit-identical to the
+        fault-free sampled oracle. The router-minted seed rides the
+        envelope on every hop, so the replayed journal resumes its
+        exact counter-key schedule on the peer."""
+        prompts = child.chaos_prompts(12, seed=5)
+        seeds = [2000 + 13 * i for i in range(len(prompts))]
+        scope = child.build_scope(seed=7)
+        sched = child.make_scheduler(
+            scope, slots=4, decode_policy=child.sampled_policy())
+        futs = [sched.submit(p, max_new_tokens=12, eos_id=-1, seed=s)
+                for p, s in zip(prompts, seeds)]
+        baseline = [[int(t) for t in f.result(timeout=300)]
+                    for f in futs]
+        sched.close()
+        assert len(set(map(tuple, baseline))) > 1
+
+        router = FleetRouter(heartbeat_timeout_ms=700,
+                             replay_attempts=6, breaker_failures=2,
+                             breaker_cooldown_ms=60000.0)
+        pol = ("--decode-policy", "sample")
+        procs = []
+        try:
+            procs.append(_spawn_child(router, "s0",
+                                      "--kill-at-token", "4", *pol))
+            procs.append(_spawn_child(router, "s1", *pol))
+            procs.append(_spawn_child(router, "s2", *pol))
+            router.wait_members(3, timeout=120)
+            futs = [router.submit(p, max_new_tokens=12, eos_id=-1,
+                                  meta=True, seed=s)
+                    for p, s in zip(prompts, seeds)]
+            results, errors = [], []
+            for i, f in enumerate(futs):
+                try:
+                    results.append(f.result(timeout=300))
+                except Exception as exc:  # noqa: BLE001
+                    results.append(None)
+                    errors.append("req %d: %r" % (i, exc))
+            assert not errors, errors
+            mism = [i for i, (got, want)
+                    in enumerate(zip(results, baseline))
+                    if got["tokens"].tolist() != want]
+            assert not mism, mism
+            assert procs[0].poll() is not None, \
+                "worker s0 should have SIGKILLed itself"
+            assert any(r["replays"] > 0 for r in results)
+        finally:
+            router.close()
+            _stop_children(procs)
+
+    def test_cross_policy_failover_resets_journal(self):
+        """A journal minted under GREEDY must never resume under a
+        SAMPLED member: the decode-policy fingerprint gate (the
+        weights-version rule extended to decode semantics) discards
+        it and restarts from the prompt, so the client receives the
+        pure sampled-from-scratch answer — never a greedy prefix
+        spliced onto a sampled continuation."""
+        prompt = [child.BOS, 9, 23, 4]
+        seed = 4242
+        scope = child.build_scope(seed=7)
+        sched = child.make_scheduler(
+            scope, slots=2, decode_policy=child.sampled_policy())
+        oracle = [int(t) for t in
+                  sched.submit(prompt, max_new_tokens=10, eos_id=-1,
+                               seed=seed).result(timeout=300)]
+        sched.close()
+        gsched = child.make_scheduler(scope, slots=2)
+        greedy = [int(t) for t in
+                  gsched.submit(prompt, max_new_tokens=10,
+                                eos_id=-1).result(timeout=300)]
+        gsched.close()
+        assert oracle != greedy  # the splice would be visible
+
+        resets0 = counter("paddle_fleet_journal_resets_total")
+        # breaker_failures=1: the dead member's breaker opens on its
+        # first failure, so the request PARKS in placement (instead
+        # of burning its replay budget on refused connections) until
+        # the sampled member registers
+        router = FleetRouter(heartbeat_timeout_ms=700,
+                             replay_attempts=6, breaker_failures=1,
+                             breaker_cooldown_ms=60000.0,
+                             placement_timeout=120.0)
+        procs = []
+        try:
+            # the only member is GREEDY and kills itself after
+            # streaming 4 tokens of the journal
+            procs.append(_spawn_child(router, "g0",
+                                      "--kill-at-token", "4"))
+            router.wait_members(1, timeout=120)
+            fut = router.submit(prompt, max_new_tokens=10, eos_id=-1,
+                                meta=True, seed=seed)
+            deadline = time.monotonic() + 120
+            while procs[0].poll() is None and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert procs[0].poll() is not None
+            # failover target: a SAMPLED member — the partial greedy
+            # journal reaching it must be reset, not resumed
+            procs.append(_spawn_child(router, "s1",
+                                      "--decode-policy", "sample"))
+            out = fut.result(timeout=300)
+            assert out["tokens"].tolist() == oracle, \
+                (out["tokens"].tolist(), oracle, greedy)
+            assert out["replays"] >= 1
+            assert counter("paddle_fleet_journal_resets_total") >= \
+                resets0 + 1
+        finally:
+            router.close()
+            _stop_children(procs)
+
     def test_rolling_deploy_under_traffic_and_bad_push_rollback(
             self, tmp_path):
         """Rolling deploy across 3 members under concurrent traffic:

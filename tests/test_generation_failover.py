@@ -58,11 +58,13 @@ def _lm_scope(seed=7):
     return scope
 
 
-def _session(scope, slots=2, warm=False, prompt_buckets=(4, 8, 12)):
+def _session(scope, slots=2, warm=False, prompt_buckets=(4, 8, 12),
+             decode_policy=None):
     spec = transformer_lm_session(V, max_len=MAXLEN, slots=slots,
                                   cache_len=MAXLEN,
                                   prompt_buckets=prompt_buckets,
-                                  bos_id=BOS, eos_id=EOS, **KW)
+                                  bos_id=BOS, eos_id=EOS,
+                                  decode_policy=decode_policy, **KW)
     sess = GenerationSession(spec, scope=scope)
     if warm:
         # compile prefill+decode ahead of traffic, so a step timeout
@@ -71,15 +73,25 @@ def _session(scope, slots=2, warm=False, prompt_buckets=(4, 8, 12)):
     return sess
 
 
-def _baseline(scope, prompts=PROMPTS, max_new=6):
+def _baseline(scope, prompts=PROMPTS, max_new=6, decode_policy=None,
+              seeds=None):
     """Fault-free scheduler run: the bit-identical oracle."""
-    sched = GenerationScheduler([_session(scope), _session(scope)])
+    sched = GenerationScheduler(
+        [_session(scope, decode_policy=decode_policy),
+         _session(scope, decode_policy=decode_policy)])
     try:
-        futs = [sched.submit(list(p), max_new_tokens=max_new, eos_id=-1)
-                for p in prompts]
+        futs = [sched.submit(list(p), max_new_tokens=max_new,
+                             eos_id=-1,
+                             seed=None if seeds is None else seeds[i])
+                for i, p in enumerate(prompts)]
         return [[int(t) for t in f.result(timeout=60)] for f in futs]
     finally:
         sched.close()
+
+
+def _sampled_policy():
+    from paddle_tpu.serving.decoding import DecodePolicy
+    return DecodePolicy(kind="sample", temperature=0.9)
 
 
 # -- token-replay failover -------------------------------------------------
@@ -106,6 +118,37 @@ class TestReplayFailover:
             assert _counter("paddle_generation_replayed_tokens_total") \
                 > r0
             # the failed session is quarantined, not resolving clients
+            assert sched.session_health()[0] == "open"
+        finally:
+            faults.disarm()
+            sched.close()
+
+    def test_persistent_step_fault_sampled_bit_identical(self):
+        """ISSUE-17 chaos acceptance, in-process half: session 0
+        PERSISTENTLY broken (times=None — the dead-replica shape)
+        under a SAMPLED policy with explicit per-request seeds. Every
+        request fails over to session 1 and resolves token-for-token
+        identical to the fault-free sampled baseline: the seed lives
+        in the request, the position counter in the journal length,
+        so the replayed suffix re-derives the exact keys."""
+        scope = _lm_scope()
+        pol = _sampled_policy()
+        seeds = [1000 + 17 * i for i in range(len(PROMPTS))]
+        want = _baseline(scope, decode_policy=pol, seeds=seeds)
+        assert len(set(map(tuple, want))) > 1  # genuinely varied
+        sched = GenerationScheduler(
+            [_session(scope, decode_policy=pol),
+             _session(scope, decode_policy=pol)],
+            replay_attempts=4, breaker_failures=1,
+            breaker_cooldown_ms=60000.0)
+        try:
+            faults.arm("generation_step_fail", at=0, times=None)
+            futs = [sched.submit(list(p), max_new_tokens=6, eos_id=-1,
+                                 seed=s)
+                    for p, s in zip(PROMPTS, seeds)]
+            got = [[int(t) for t in f.result(timeout=60)]
+                   for f in futs]
+            assert got == want
             assert sched.session_health()[0] == "open"
         finally:
             faults.disarm()
@@ -337,6 +380,36 @@ class TestSessionRebuild:
             faults.disarm()
             sched.close()
 
+    @pytest.mark.slow  # a second full rebuild cycle (~13 s); sampled
+    # bit-identity under faults stays tier-1 via the persistent
+    # step-fault test, greedy rebuild correctness via the test above
+    def test_rebuilt_sampled_session_keeps_policy_bit_identical(self):
+        """ISSUE-17 chaos: a SAMPLED session torn down and rebuilt
+        mid-request continues the stream bit-identically — the
+        rebuild re-runs transformer_lm_session with the SAME policy,
+        and the journal re-admits with the request's seed, so the
+        counter keys of the regenerated positions line up exactly."""
+        scope = _lm_scope()
+        pol = _sampled_policy()
+        seed = 31337
+        want = _baseline(scope, prompts=([BOS],), max_new=5,
+                         decode_policy=pol, seeds=[seed])[0]
+        sess = _session(scope, decode_policy=pol)
+        sched = GenerationScheduler(
+            [sess], replay_attempts=10, breaker_failures=1,
+            breaker_cooldown_ms=30.0, rebuild_limit=2)
+        try:
+            faults.arm("generation_step_fail", at=0, times=3)
+            got = [int(t) for t in
+                   sched.submit([BOS], max_new_tokens=5, eos_id=-1,
+                                seed=seed).result(timeout=60)]
+            assert got == want
+            assert sched.sessions[0].sampled  # policy survived rebuild
+            assert sched.policy_fingerprint() == pol.fingerprint()
+        finally:
+            faults.disarm()
+            sched.close()
+
     def test_rebuild_budget_bounded(self):
         """rebuild_limit bounds reconstruction attempts per session —
         a session broken beyond its budget stays out."""
@@ -493,6 +566,13 @@ class TestDefaultOff:
         assert ptpu.config.get_flag("fleet_metrics_interval_ms") == 0
         assert ptpu.config.get_flag("slo_target_p99_ms") == 0
         assert ptpu.config.get_flag("slo_windows") == (5.0, 60.0)
+        assert ptpu.config.get_flag("decode_policy") == "greedy"
+        assert ptpu.config.get_flag("decode_temperature") == 1.0
+        assert ptpu.config.get_flag("decode_top_k") == 0
+        assert ptpu.config.get_flag("decode_top_p") == 1.0
+        assert ptpu.config.get_flag("decode_speculate_k") == 0
+        assert ptpu.config.get_flag("decode_draft_model") is None
+        assert ptpu.config.get_flag("decode_constraint") is None
 
     def test_dispatcher_hot_path_reads_no_flags(self, monkeypatch):
         """Acceptance: with the flags at defaults the dispatcher loop
@@ -535,7 +615,8 @@ class TestDefaultOff:
                                          "trace_sample_rate",
                                          "telemetry_port",
                                          "flight_dir",
-                                         "fleet_", "slo_"))]
+                                         "fleet_", "slo_",
+                                         "decode_"))]
             workers = [t for t in threading.enumerate()
                        if t.name.startswith("generation-step-")]
             assert not workers

@@ -1,0 +1,189 @@
+"""Decode-policy probe (ISSUE 17): sampling overhead, speculative
+accept rate and speedup, headless.
+
+Builds one heavier-than-test causal LM (the regime where speculative
+decoding pays: target forward cost dominates the host loop) with a
+GPT-2-style small-residual-branch init — LayerNorms at their real
+init (gain 1 / bias 0) and the residual-WRITING projections
+(attention out-proj, ffn2) scaled by eps/sqrt(fan_in) — so the
+residual stream is embedding-dominated and a 1-layer truncated draft
+genuinely predicts the target's argmax most steps (an HONEST accept
+rate below 1.0: the full stack still flips close calls). Two traps
+this init dodges, found empirically: scaling ALL weights uniformly
+shrinks logit gaps and per-layer deltas TOGETHER (agreement never
+improves), and random LN gains make the truncated draft's final LN
+bind to a different random transform than the target's (0% agreement
+at any scale). Measures:
+
+1. ``sampling_overhead_pct`` — single-slot decode latency of the
+   temperature/top-k sampled policy vs plain argmax (the fused
+   on-device sampler's cost).
+2. ``speculative_accept_rate`` — accepted / drafted tokens with a
+   1-layer draft at k=4.
+3. ``speculative_speedup_len{64,128}`` — wall-clock decode speedup of
+   speculative over plain greedy for 64- and 128-token generations,
+   single slot (the latency-bound serving shape).
+
+Prints one JSON doc; exits non-zero if speculative decode emits
+different tokens than plain greedy (it must be trajectory-identical)
+or the pool invariant breaks. Numbers land in PROFILE.md round 19.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/decode_policy_probe.py
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+VOCAB = 256
+KW = dict(d_model=256, num_heads=4, d_ff=1024, num_layers=6)
+MAX_LEN = 160
+BOS, EOS = 0, 1
+# Residual-writer scale eps: each block writes ~eps (relative to the
+# unit-variance stream) because the /sqrt(fan_in) factor cancels the
+# ~sqrt(d) gain of a random N(0,1) matrix. 1e-3 puts the 1-layer
+# draft at ~0.95 acceptance against the 6-layer target.
+RESIDUAL_EPS = 1e-3
+SPECULATE_K = 4
+
+
+def build_scope():
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+    from paddle_tpu.models.transformer import transformer_lm
+
+    with ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            toks = layers.data("toks", shape=[1, MAX_LEN],
+                               dtype="int64", append_batch_size=False)
+            lbls = layers.data("lbls", shape=[1, MAX_LEN],
+                               dtype="int64", append_batch_size=False)
+            transformer_lm(toks, lbls, vocab_size=VOCAB, is_test=True,
+                           **KW)
+    exe = ptpu.Executor()
+    scope = ptpu.Scope()
+    with ptpu.scope_guard(scope):
+        exe.run(startup)
+    rs = np.random.RandomState(7)
+    for n in sorted(scope.var_names()):
+        cur = np.asarray(scope.find_var(n))
+        if not np.issubdtype(cur.dtype, np.floating):
+            continue
+        if n.startswith("layer_norm"):
+            continue  # keep the real init: gain 1 / bias 0
+        w = rs.standard_normal(cur.shape)
+        if ".o.w" in n or ".ffn2." in n:
+            fan_in = cur.shape[0] if cur.ndim == 2 else 1
+            w = w * (RESIDUAL_EPS / np.sqrt(max(fan_in, 1)))
+        scope.set_var(n, w.astype(cur.dtype))
+    return scope
+
+
+def session(scope, policy):
+    from paddle_tpu.models.transformer import transformer_lm_session
+    from paddle_tpu.serving.generation import GenerationSession
+
+    spec = transformer_lm_session(
+        VOCAB, max_len=MAX_LEN, slots=1, prompt_buckets=(8,),
+        bos_id=BOS, eos_id=EOS, paged=True, block_size=16,
+        decode_policy=policy, **KW)
+    return GenerationSession(spec, scope=scope)
+
+
+def timed_generate(sess, prompt, n, seed=0):
+    sess.generate(prompt, max_new_tokens=4, eos_id=-1,
+                  seed=seed)  # warm compile
+    t0 = time.perf_counter()
+    out = sess.generate(prompt, max_new_tokens=n, eos_id=-1,
+                        seed=seed)
+    dt = time.perf_counter() - t0
+    return out, dt
+
+
+def main():
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.serving.decoding import DecodePolicy
+
+    def counter(name):
+        for s in (metrics.REGISTRY.dump().get(name, {})
+                  .get("samples", ())):
+            return s["value"]
+        return 0.0
+
+    scope = build_scope()
+    prompt = [BOS, 5, 7, 11]
+    doc = {}
+
+    # -- 1. sampling overhead vs argmax --------------------------------
+    plain = session(scope, None)
+    greedy64, t_greedy = timed_generate(plain, prompt, 64)
+    plain.close()
+    sampled = session(scope, DecodePolicy(kind="sample",
+                                          temperature=0.9, top_k=40))
+    _, t_sampled = timed_generate(sampled, prompt, 64, seed=1234)
+    sampled.close()
+    doc["sampling_overhead_pct"] = round(
+        100.0 * (t_sampled - t_greedy) / t_greedy, 1)
+    doc["greedy_tokens_per_sec_len64"] = round(64 / t_greedy, 1)
+
+    # -- 2/3. speculative: accept rate + speedup -----------------------
+    ok = True
+    spec_pol = DecodePolicy(kind="greedy", speculate_k=SPECULATE_K)
+    for n in (64, 128):
+        plain = session(scope, None)
+        base, t_plain = timed_generate(plain, prompt, n)
+        plain.close()
+
+        d0 = counter("paddle_generation_speculative_drafted_total")
+        a0 = counter("paddle_generation_speculative_accepted_total")
+        spec = session(scope, spec_pol)
+        out, t_spec = timed_generate(spec, prompt, n)
+        try:
+            spec.check_pool_invariant()
+        except Exception as exc:  # noqa: BLE001
+            print("POOL INVARIANT BROKEN: %r" % (exc,),
+                  file=sys.stderr)
+            ok = False
+        spec.close()
+        if out != base:
+            print("SPECULATIVE OUTPUT DIVERGED at len %d" % n,
+                  file=sys.stderr)
+            ok = False
+        drafted = counter(
+            "paddle_generation_speculative_drafted_total") - d0
+        accepted = counter(
+            "paddle_generation_speculative_accepted_total") - a0
+        doc["speculative_speedup_len%d" % n] = round(
+            t_plain / t_spec, 2)
+        if n == 64:
+            doc["speculative_accept_rate"] = round(
+                accepted / max(drafted, 1.0), 3)
+            doc["speculative_tokens_per_sec_len64"] = round(
+                n / t_spec, 1)
+
+    # speculative must actually pay at serving lengths, with a real
+    # (non-zero, sub-1-rigged-looking is fine, zero is not) accept rate
+    if doc["speculative_accept_rate"] <= 0.0:
+        print("SPECULATIVE ACCEPT RATE IS ZERO", file=sys.stderr)
+        ok = False
+    for n in (64, 128):
+        if doc["speculative_speedup_len%d" % n] <= 1.0:
+            print("SPECULATIVE SLOWER THAN GREEDY at len %d" % n,
+                  file=sys.stderr)
+            ok = False
+    doc["ok"] = ok and len(greedy64) == 64
+    print(json.dumps(doc, indent=2))
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
