@@ -1128,8 +1128,10 @@ def bench_fleet(on_accel):
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tests"))
     import fleet_worker_child as child
+    from paddle_tpu.observability import metrics as obs_metrics
     from paddle_tpu.serving import wire
-    from paddle_tpu.serving.fleet import FleetRouter
+    from paddle_tpu.serving.autoscale import FleetAutoscaler
+    from paddle_tpu.serving.fleet import FleetRouter, TenantQuotaError
 
     suffix = "" if on_accel else "_cpu_smoke"
     tmp = tempfile.mkdtemp(prefix="bench_fleet_")
@@ -1204,11 +1206,42 @@ def bench_fleet(on_accel):
             raise RuntimeError("worker m0 was never killed")
         p99_kill = float(np.percentile(lat_ms, 99))
 
-        # cold-member scale-up: spawn-to-first-token (warm cache)
+        # cold-member scale-up through the AUTOSCALER spawn path
+        # (PR 18): request_scale_up launches the process, the
+        # pending->REG sweep rides the router monitor, and the first
+        # token is pulled from the joined member itself (warm cache)
+        ports = {}
+
+        def as_spawn(mid):
+            proc, port = spawn(router, mid)
+            procs.append(proc)
+            ports[mid] = port
+            return proc
+
+        scaler = FleetAutoscaler(
+            router, as_spawn, members_max=8, burn_threshold=1.0,
+            cooldown_ms=200.0, idle_ms=3600e3,
+            spawn_timeout_ms=120e3, spawn_failure_budget=2,
+            member_prefix="up")
         t_up0 = time.perf_counter()
-        proc2, port2 = spawn(router, "m2")
-        procs.append(proc2)
-        conn = wire.LineConn.connect(("127.0.0.1", port2),
+        up_mid = scaler.request_scale_up()
+        if up_mid is None:
+            raise RuntimeError("autoscaler refused the scale-up")
+        join_deadline = time.monotonic() + 300
+        while up_mid not in router.members_live():
+            if time.monotonic() > join_deadline:
+                raise RuntimeError("scale-up member never joined")
+            time.sleep(0.02)
+        # sweep pending -> joined before detaching (close() reaps
+        # anything still pending; this member is the fleet's now)
+        while scaler.doc()["pending"]:
+            scaler.tick()
+            time.sleep(0.01)
+        if scaler.spawn_failures:
+            raise RuntimeError("autoscaler charged a spawn failure "
+                               "during the scale-up bench")
+        scaler.close()
+        conn = wire.LineConn.connect(("127.0.0.1", ports[up_mid]),
                                      timeout=300.0)
         conn.send({"cmd": "generate", "prompt": prompts[0],
                    "max_new": 2, "eos_id": -1})
@@ -1259,6 +1292,86 @@ def bench_fleet(on_accel):
                 "rolling deploy broke the zero-error/one-version "
                 "contract: errors=%r mixed=%d"
                 % (errors[:3], len(mixed)))
+
+        # two-tenant burst (PR 18): the burster floods past its
+        # in-flight quota while the victim's steady trickle runs at
+        # higher priority — the victim must NEVER shed (isolation),
+        # and the SLO violation seconds across the burst are the
+        # capacity-pressure tripwire
+        router2 = FleetRouter(
+            heartbeat_timeout_ms=700, replay_attempts=3,
+            slo_target_p99_ms=250.0, slo_windows=(5.0, 60.0),
+            tenants={"burst": {"quota": 2, "priority": 1},
+                     "victim": {"quota": 0, "priority": 0}},
+            member_inflight_limit=4)
+        try:
+            procs.append(spawn(router2, "t0")[0])
+            router2.wait_members(1, timeout=300)
+            burst_sheds, burst_errors = [], []
+            victim_served, victim_errors = [], []
+            burst_end = time.monotonic() + 2.0
+
+            def burster(seed):
+                rs = np.random.RandomState(seed)
+                while time.monotonic() < burst_end:
+                    p = [child.BOS] + [int(t) for t in
+                                       rs.randint(2, child.VOCAB, 3)]
+                    try:
+                        router2.submit(
+                            p, max_new_tokens=3, eos_id=-1,
+                            tenant="burst").result(timeout=120)
+                    except TenantQuotaError:
+                        burst_sheds.append(1)  # its own quota: fine
+                        time.sleep(0.005)      # refusal is instant;
+                        # back off so the burst is load, not a spin
+                    except Exception as exc:  # noqa: BLE001
+                        burst_errors.append(repr(exc))
+
+            def victim():
+                rs = np.random.RandomState(29)
+                while time.monotonic() < burst_end:
+                    p = [child.BOS] + [int(t) for t in
+                                       rs.randint(2, child.VOCAB, 3)]
+                    try:
+                        victim_served.append(router2.submit(
+                            p, max_new_tokens=3, eos_id=-1,
+                            tenant="victim").result(timeout=120))
+                    except Exception as exc:  # noqa: BLE001
+                        victim_errors.append(repr(exc))
+
+            burst_threads = [threading.Thread(target=burster,
+                                              args=(31 + i,),
+                                              daemon=True)
+                             for i in range(4)]
+            burst_threads.append(threading.Thread(target=victim,
+                                                  daemon=True))
+            for t in burst_threads:
+                t.start()
+            for t in burst_threads:
+                t.join(timeout=300)
+            violation_s = (router2.slo.violation_seconds
+                           if router2.slo is not None else 0.0)
+            victim_label = "f%d:victim" % router2._rid
+            victim_sheds = 0.0
+            for s in obs_metrics.REGISTRY.dump().get(
+                    "paddle_serving_tenant_shed_total",
+                    {}).get("samples", ()):
+                if s["labels"].get("tenant") == victim_label:
+                    victim_sheds = s["value"]
+            isolation = victim_sheds + len(victim_errors)
+            if victim_errors or burst_errors:
+                raise RuntimeError(
+                    "two-tenant burst broke the zero-client-error "
+                    "contract: victim=%r burster=%r"
+                    % (victim_errors[:3], burst_errors[:3]))
+            if not victim_served or not burst_sheds:
+                raise RuntimeError(
+                    "burst produced no pressure (victim=%d served, "
+                    "burster sheds=%d) — the isolation metric would "
+                    "be vacuous" % (len(victim_served),
+                                    len(burst_sheds)))
+        finally:
+            router2.close()
     finally:
         router.close()
         for p in procs:
@@ -1280,8 +1393,10 @@ def bench_fleet(on_accel):
     }, {
         "metric": "scale_up_to_first_token_ms" + suffix,
         "value": round(first_token_ms, 1),
-        "unit": "ms from worker-process spawn to its first generated "
-                "token (persistent compile cache warm)",
+        "unit": "ms from FleetAutoscaler.request_scale_up to the "
+                "spawned member's first generated token (process "
+                "launch + REG join + decode, persistent compile "
+                "cache warm)",
         "higher_is_better": False,
         "vs_baseline": 1.0,
         # interpreter + jax import dominates on CPU; the wire exists
@@ -1297,6 +1412,29 @@ def bench_fleet(on_accel):
         "higher_is_better": False,
         "vs_baseline": 1.0,
         "responses_during_deploy": len(responses),
+        "must_be_zero": True,
+    }, {
+        "metric": "slo_violation_seconds_per_burst" + suffix,
+        "value": round(float(violation_s), 3),
+        "unit": "seconds the fast-window burn rate spent above 1.0 "
+                "across a 2 s two-tenant quota burst (burster over "
+                "quota, victim steady)",
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        "burster_quota_sheds": len(burst_sheds),
+        # the burst is sized to shed the burster, not to melt the
+        # fleet: sustained burn past the window length means victim
+        # traffic is burning budget too
+        "regression_floor": 10.0,
+    }, {
+        "metric": "tenant_shed_isolation" + suffix,
+        "value": float(isolation),
+        "unit": "victim-tenant sheds + victim client errors while "
+                "the burster floods past its quota (MUST be 0 — "
+                "quota refusals land on the burster alone)",
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        "victim_served": len(victim_served),
         "must_be_zero": True,
     }]
 

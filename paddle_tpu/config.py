@@ -289,6 +289,47 @@ slo_windows: the SLO burn-rate window widths in seconds, shortest
   alert, the slow window confirms it is sustained). Read only at
   tracker construction.
 
+fleet_members_max: the autoscaler's upper capacity bound — live
+  members plus pending spawns never exceed it, no matter how hard the
+  SLO burns (a runaway burn cannot fork-bomb the host). Read only at
+  FleetAutoscaler construction; without an autoscaler attached nothing
+  reads it.
+
+fleet_tenants: the multi-tenant admission table, or None (default) —
+  a dict of ``tenant id -> {"quota": N, "priority": P}``. quota is the
+  max in-flight requests that tenant may hold at the router (0 =
+  unlimited); priority orders placement under contention (lower number
+  wins). A ``"*"`` entry sets the policy for tenants not named.
+  None: the router builds no tenant table, ``submit(tenant=...)`` is
+  carried for tracing only, and no per-tenant child metrics exist.
+  Read only at router construction.
+
+autoscale_burn_threshold: fast-window SLO burn rate above which the
+  autoscaler calls the fleet under-provisioned and spawns a member
+  (1.0 = burning budget exactly as fast as the objective allows).
+  Read only at FleetAutoscaler construction.
+
+autoscale_cooldown_ms: minimum spacing between ANY two capacity
+  actions (spawn or retire) — the hysteresis that keeps a flapping
+  breaker or a noisy burn signal from oscillating capacity. Read only
+  at FleetAutoscaler construction.
+
+autoscale_idle_ms: how long a member must hold zero in-flight
+  requests before the autoscaler will drain and retire it (never below
+  ``fleet_members_min``). Read only at FleetAutoscaler construction.
+
+autoscale_spawn_timeout_ms: the bound on spawn-to-REG — a spawned
+  process that has not joined the membership within it is killed and
+  charged to the spawn-failure budget (the monitor tick is never
+  blocked; the sweep just checks deadlines). Read only at
+  FleetAutoscaler construction.
+
+autoscale_spawn_failures: the spawn-failure budget — after this many
+  failed or wedged spawns the autoscaler stops spawning (scale-downs
+  still run) until ``reset_spawn_budget()``; a persistently broken
+  launch path degrades to a fixed-size fleet instead of a crash loop.
+  Read only at FleetAutoscaler construction.
+
 embedding_shard_rows: if True, DistEmbedding tables created by
   ``layers.embedding(..., is_distributed=True)`` are row-sharded over
   the mesh data axis by ``row_id % num_shards`` (mod-interleaved
@@ -396,6 +437,18 @@ _flags = {
     "fleet_metrics_interval_ms": 0.0,
     "slo_target_p99_ms": 0.0,
     "slo_windows": (5.0, 60.0),
+    # autoscaling + multi-tenancy (serving/autoscale.py + fleet.py;
+    # read only inside FleetAutoscaler construction / FleetRouter
+    # construction — defaults construct no autoscaler, no tenant
+    # table, no extra threads or sockets, and the monitor tick gates
+    # on one attribute-is-None check)
+    "fleet_members_max": 8,
+    "fleet_tenants": None,
+    "autoscale_burn_threshold": 1.0,
+    "autoscale_cooldown_ms": 5000.0,
+    "autoscale_idle_ms": 10000.0,
+    "autoscale_spawn_timeout_ms": 30000.0,
+    "autoscale_spawn_failures": 3,
     # sharded embedding tables (embeddings/sharded.py; read only when a
     # program registered a DistEmbedding — defaults construct none of
     # the subsystem and plain programs never read these)

@@ -471,6 +471,27 @@ class FleetAggregator:
                     "ingests": self.ingests,
                     "members": members}
 
+    def counter_children(self, name, label):
+        """The fleet-accumulated totals of one counter family, split
+        by ONE label's values: ``{label value: total}``. The
+        per-tenant drill: ``counter_children(
+        "paddle_serving_tenant_shed_total", "tenant")`` answers
+        "which tenant's traffic shed, fleet-wide" from the deltas
+        every member shipped — the isolation proof the autoscale
+        chaos probe asserts on."""
+        label = str(label)
+        out = {}
+        with self._lock:
+            acc = self._counters.get(name)
+            if not acc:
+                return out
+            for (ln, values), v in acc.items():
+                child = dict(zip(ln, values))
+                if label in child:
+                    key = child[label]
+                    out[key] = out.get(key, 0.0) + v
+        return out
+
     def counter_value(self, name, **labels):
         """The fleet-accumulated delta total for one counter child
         (conservation asserts in tests/probes read this)."""

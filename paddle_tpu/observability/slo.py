@@ -33,7 +33,8 @@ from collections import deque
 from .. import config
 from . import metrics as _metrics
 
-__all__ = ["SLOTracker", "local_source", "DEFAULT_BAD_COUNTERS"]
+__all__ = ["SLOTracker", "local_source", "labeled_source",
+           "DEFAULT_BAD_COUNTERS"]
 
 DEFAULT_HISTOGRAM = "paddle_request_e2e_ms"
 DEFAULT_BAD_COUNTERS = ("paddle_serving_shed_total",
@@ -74,6 +75,49 @@ def local_source(histogram=DEFAULT_HISTOGRAM,
                     count += int(ccount)
             elif name in bad_counters and kind == "counter":
                 for _labels, payload in children:
+                    bad += float(payload)
+        nslots = len(buckets) + 1 if buckets else 0
+        return {"buckets": buckets,
+                "counts": counts if counts is not None else [0] * nslots,
+                "count": count, "bad": bad}
+
+    return source
+
+
+def labeled_source(histogram=DEFAULT_HISTOGRAM,
+                   bad_counters=DEFAULT_BAD_COUNTERS,
+                   label=None, value=None, registry=None):
+    """:func:`local_source` restricted to ONE labeled child per
+    family: only children whose ``label`` equals ``value`` are summed.
+    This is how per-tenant SLO verdicts slice the shared families —
+    one tracker per tenant, each reading its own
+    ``paddle_fleet_tenant_request_ms{tenant=...}`` child and the
+    matching shed/deadline children, so a bursting tenant burns its
+    OWN budget while the victim tenant's verdict stays green."""
+    reg = registry if registry is not None else _metrics.REGISTRY
+    bad_counters = tuple(bad_counters)
+    label = str(label)
+    value = str(value)
+
+    def source():
+        buckets, counts, count, bad = (), None, 0, 0.0
+        for name, kind, _help, b, children in reg.snapshot():
+            if name == histogram and kind == "histogram":
+                buckets = tuple(b or ())
+                for labels, payload in children:
+                    if labels.get(label) != value:
+                        continue
+                    ccounts, ccount, _sum, _mn, _mx = payload
+                    if counts is None:
+                        counts = [0] * len(ccounts)
+                    if len(ccounts) == len(counts):
+                        for i, c in enumerate(ccounts):
+                            counts[i] += int(c)
+                    count += int(ccount)
+            elif name in bad_counters and kind == "counter":
+                for labels, payload in children:
+                    if labels.get(label) != value:
+                        continue
                     bad += float(payload)
         nslots = len(buckets) + 1 if buckets else 0
         return {"buckets": buckets,

@@ -99,6 +99,19 @@ site                          where / what
                               ``action="callback"`` sleeping past the
                               router's ``call_timeout`` to simulate a
                               wedged member (hang = instant breaker open)
+``fleet_spawn_fail``          FleetAutoscaler launch thread, before the
+                              spawn callable runs — ``index`` is the
+                              would-be member id; a raising spec IS the
+                              spawn that died before REGistering: the
+                              pending entry resolves to a failure and is
+                              charged to the spawn-failure budget
+``fleet_spawn_slow``          FleetAutoscaler launch thread, after the
+                              spawn callable returned — arm with
+                              ``action="callback"`` sleeping past
+                              ``autoscale_spawn_timeout_ms``: the launch
+                              wedges, the monitor tick's sweep (never
+                              blocked by it) kills the handle and
+                              charges the budget at the deadline
 ``decode_draft_mismatch``     GenerationSession speculative verify —
                               ``index`` is the slot; one firing forces
                               that slot's round to accept ZERO draft

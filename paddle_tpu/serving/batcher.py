@@ -60,7 +60,7 @@ from ..resilience import faults as _faults
 from . import resilience as _sres
 from .resilience import ServingDeadlineError
 
-__all__ = ["MicroBatcher", "ServingOverloadError"]
+__all__ = ["MicroBatcher", "ServingOverloadError", "TenantQuotaError"]
 
 _REQUEST_SECONDS = _metrics.REGISTRY.histogram(
     "paddle_serving_request_seconds",
@@ -73,6 +73,17 @@ _QUEUE_DEPTH = _metrics.REGISTRY.gauge(
 class ServingOverloadError(RuntimeError):
     """Admission refused: the bounded queue stayed full past the submit
     timeout, or the projected queue wait exceeds the deadline budget."""
+
+
+class TenantQuotaError(ServingOverloadError):
+    """Admission refused because THIS tenant is over its in-flight
+    quota — an overload scoped to one tenant, so callers (and the
+    chaos probes) can tell "the fleet is full" from "you are bursting".
+    Carries the tenant id as ``.tenant``."""
+
+    def __init__(self, tenant, message):
+        super().__init__(message)
+        self.tenant = tenant
 
 
 class _WorkItem:
@@ -170,7 +181,8 @@ class MicroBatcher:
                 "feed %r: example dtype %s is not numeric (model "
                 "expects %s)" % (name, a.dtype, spec[1]))
 
-    def submit(self, feed, timeout=None, deadline_ms=None):
+    def submit(self, feed, timeout=None, deadline_ms=None,
+               tenant=None):
         """Enqueue one example; returns a Future of its outputs.
 
         ``deadline_ms``: serve-by budget from now (default: the
@@ -182,7 +194,10 @@ class MicroBatcher:
         resolves its Future with :class:`ServingDeadlineError` without
         reaching a device. ``timeout``: seconds to wait on a full
         queue; raises :class:`ServingOverloadError` instead of blocking
-        forever."""
+        forever. ``tenant``: attribution only — a shed of a
+        tenant-tagged submit also charges
+        ``paddle_serving_tenant_shed_total{tenant=...}`` (quota
+        enforcement itself lives at the fleet router)."""
         if self._closed:
             raise RuntimeError("batcher is closed")
         seq = next(self._submit_seq)
@@ -191,6 +206,8 @@ class MicroBatcher:
                                default_exc=ServingOverloadError)
         except ServingOverloadError:
             _sres.SHED.inc()
+            if tenant is not None:
+                _sres.TENANT_SHED.labels(tenant=str(tenant)).inc()
             raise
         if deadline_ms is None:
             deadline_ms = _config.get_flag("serving_deadline_ms")
@@ -213,6 +230,9 @@ class MicroBatcher:
                 # observed wait re-anchors the estimate.
                 self._wait_ewma *= (1.0 - _WAIT_ALPHA)
                 _sres.SHED.inc()
+                if tenant is not None:
+                    _sres.TENANT_SHED.labels(
+                        tenant=str(tenant)).inc()
                 raise ServingOverloadError(
                     "shed: projected queue wait %.1f ms exceeds the "
                     "%.1f ms deadline budget"

@@ -59,6 +59,29 @@ envelope carries the router-minted trace id; the router stamps a
 worker's ack, so one request killed mid-generation reads router ->
 dead member -> replay-on-peer in a single ``/debug/trace`` tree.
 
+Multi-tenancy (PR 18): requests carry a **tenant id** end-to-end —
+``submit(tenant=...)`` -> the generate envelope -> the worker's
+backend (signature-gated, like the seed) — and the journal living
+router-side means every replay hop re-sends it for free, exactly the
+PR-17 seed discipline. With a ``tenants`` table armed (the
+``fleet_tenants`` flag, or the constructor arg) the router enforces
+per-tenant **admission quotas** (max in-flight; over-quota submits
+raise the typed
+:class:`~paddle_tpu.serving.batcher.TenantQuotaError` and charge
+``paddle_serving_tenant_shed_total{tenant=...}`` — a bursting tenant
+sheds ITS traffic while the others' p99 holds) and **priority
+tiers** (placement under contention yields to strictly
+higher-priority waiters). Per-tenant latency histograms feed
+per-tenant SLOTracker verdicts under ``/debug/slo`` when both the
+SLO target and the table are armed.
+
+Autoscaling (serving/autoscale.py): an attached
+:class:`~paddle_tpu.serving.autoscale.FleetAutoscaler` rides the
+monitor tick — spawns EngineWorker processes on SLO pressure, drains
+and retires them idle (``retire_member``), bounded by
+``fleet_members_min``/``fleet_members_max``. The router never
+constructs one.
+
 Fault sites (resilience/faults.py): ``fleet_member_kill`` (worker
 side, indexed by streamed-token count — ``action="kill"`` SIGKILLs
 the worker mid-generation), ``fleet_network_partition`` (router side
@@ -66,13 +89,15 @@ before dispatch, indexed by member id — and the worker's heartbeat
 loop swallows beats under the same site, so one arm simulates both
 directions of a partition), ``fleet_slow_member`` (worker side before
 serving, indexed by member id — arm a callback sleeping past the
-router's call timeout).
+router's call timeout), plus the autoscaler's ``fleet_spawn_fail`` /
+``fleet_spawn_slow`` (serving/autoscale.py).
 
 Default flags construct NONE of this: no router, no worker, no
-sockets, no threads. ``fleet_heartbeat_ms`` / ``fleet_members_min`` /
-``fleet_canary_fraction`` are read only inside these constructors —
-single-process serving behavior and hot-path flag-check counts are
-byte-identical with the fleet unused.
+sockets, no threads, no autoscaler, no tenant table.
+``fleet_heartbeat_ms`` / ``fleet_members_min`` /
+``fleet_canary_fraction`` / ``fleet_tenants`` are read only inside
+these constructors — single-process serving behavior and hot-path
+flag-check counts are byte-identical with the fleet unused.
 """
 
 import inspect
@@ -97,12 +122,12 @@ from ..resilience import faults as _faults
 from ..utils import log as _log
 from . import resilience as _sres
 from . import wire as _wire
-from .batcher import _resolve
+from .batcher import _WAIT_ALPHA, TenantQuotaError, _resolve
 from .decoding.policy import GREEDY_FINGERPRINT, mint_seed
 from .resilience import (ReplicaBreaker, ServingDeadlineError,
                          ServingUnavailableError)
 
-__all__ = ["FleetRouter", "EngineWorker"]
+__all__ = ["FleetRouter", "EngineWorker", "TenantQuotaError"]
 
 _REQUESTS = _metrics.REGISTRY.counter(
     "paddle_fleet_requests_total",
@@ -145,6 +170,22 @@ _REQUEST_MS = _metrics.REGISTRY.histogram(
     "paddle_fleet_request_ms",
     "Router submit -> resolution per fleet request (all hops)",
     buckets=_metrics.LATENCY_MS_BUCKETS)
+_TENANT_REQUEST_MS = _metrics.REGISTRY.histogram(
+    "paddle_fleet_tenant_request_ms",
+    "Router submit -> resolution, one child per tenant (the "
+    "per-tenant slice of paddle_fleet_request_ms — a separate family "
+    "because a registered family's labelnames are immutable); only "
+    "populated when the router has a tenant table",
+    labelnames=("tenant",), buckets=_metrics.LATENCY_MS_BUCKETS)
+_TENANT_DEADLINE = _metrics.REGISTRY.counter(
+    "paddle_fleet_tenant_deadline_total",
+    "Deadline-expired fleet requests attributed to one tenant "
+    "(feeds that tenant's SLO bad count)", labelnames=("tenant",))
+_TENANT_ACTIVE = _metrics.REGISTRY.gauge(
+    "paddle_fleet_tenant_active",
+    "Requests one tenant currently holds in flight at the router "
+    "(the quantity its admission quota bounds)",
+    labelnames=("tenant",))
 _RECOVERY_SECONDS = _metrics.REGISTRY.histogram(
     "paddle_fleet_recovery_seconds",
     "Member failure -> first replayed token streaming from a peer "
@@ -195,15 +236,31 @@ class _Member:
         self.index = index    # dense join order (breaker index)
 
 
+class _Tenant:
+    """One admission-table row: quota (max in-flight at the router,
+    0 = unlimited), priority (lower wins placement under contention),
+    and the live accounting the quota check reads."""
+    __slots__ = ("id", "quota", "priority", "active", "sheds", "label")
+
+    def __init__(self, tid, quota, priority, label):
+        self.id = tid
+        self.quota = int(quota or 0)
+        self.priority = int(priority or 0)
+        self.active = 0
+        self.sheds = 0
+        self.label = label   # "f<router>:<tenant>" — child namespace
+
+
 class _FleetRequest:
     __slots__ = ("prompt", "tokens", "max_new", "eos_id", "deadline",
                  "future", "meta", "ctx", "replays", "charged",
                  "failed_on", "canary", "tokens_version",
                  "tokens_policy", "seed", "version",
-                 "version_start", "member", "fail_t", "t_submit")
+                 "version_start", "member", "fail_t", "t_submit",
+                 "tenant", "tenant_entry")
 
     def __init__(self, prompt, max_new, eos_id, deadline, meta,
-                 seed=0):
+                 seed=0, tenant=None):
         self.prompt = [int(t) for t in prompt]
         self.tokens = []          # the replay journal's generated half
         self.max_new = max_new
@@ -224,6 +281,11 @@ class _FleetRequest:
         self.member = None
         self.fail_t = None        # failure instant, for recovery hist
         self.t_submit = time.perf_counter()
+        # tenant id, carried end-to-end like the seed: submit ->
+        # envelope -> (journal lives router-side, so every replay hop
+        # re-sends it for free)
+        self.tenant = None if tenant is None else str(tenant)
+        self.tenant_entry = None  # admission row to release, or None
 
     def journal(self):
         return self.prompt + self.tokens
@@ -251,6 +313,12 @@ class FleetRouter:
     is the share of live traffic a mid-deploy canary member receives;
     ``members_min`` (default: the ``fleet_members_min`` flag) is the
     /healthz liveness threshold and the ``wait_members`` default.
+    ``tenants`` (default: the ``fleet_tenants`` flag) arms the
+    multi-tenant admission table — ``{tenant: {"quota": N,
+    "priority": P}}``, ``"*"`` for the unknown-tenant policy;
+    ``member_inflight_limit`` (> 0) caps per-member in-flight so
+    placement becomes a contended resource (requests queue at the
+    router — what priority tiers and the placement-wait EWMA act on).
     """
 
     def __init__(self, host="127.0.0.1", port=0,
@@ -259,7 +327,8 @@ class FleetRouter:
                  call_timeout=120.0, connect_timeout=5.0,
                  placement_timeout=30.0, canary_fraction=None,
                  members_min=None, metrics_interval_ms=None,
-                 slo_target_p99_ms=None, slo_windows=None):
+                 slo_target_p99_ms=None, slo_windows=None,
+                 tenants=None, member_inflight_limit=0):
         self._rid = next(_ROUTER_SEQ)
         if heartbeat_timeout_ms is None:
             heartbeat_timeout_ms = \
@@ -283,6 +352,42 @@ class FleetRouter:
         if members_min is None:
             members_min = _config.get_flag("fleet_members_min")
         self.members_min = int(members_min)
+        if tenants is None:
+            tenants = _config.get_flag("fleet_tenants")
+        # the tenant table: None (default) = single-tenant router, no
+        # table, no per-tenant children, submit(tenant=) carried for
+        # tracing only. A "*" row is the policy unknown tenants get.
+        self._tenants = None
+        self._tenant_default = (0, 0)   # (quota, priority) fallback
+        self._tenant_slos = {}
+        if tenants:
+            self._tenants = {}
+            for tid, pol in dict(tenants).items():
+                if isinstance(pol, dict):
+                    quota = pol.get("quota", 0)
+                    priority = pol.get("priority", 0)
+                else:
+                    quota, priority = pol
+                if str(tid) == "*":
+                    self._tenant_default = (int(quota or 0),
+                                            int(priority or 0))
+                else:
+                    tid = str(tid)
+                    self._tenants[tid] = _Tenant(
+                        tid, quota, priority,
+                        "f%d:%s" % (self._rid, tid))
+        # per-member in-flight cap: 0 (default) = least-loaded only,
+        # members absorb any depth. >0 makes placement a real resource
+        # (requests queue AT THE ROUTER when every member is full),
+        # which is what gives priority tiers and the placement-wait
+        # EWMA something to act on.
+        self.member_inflight_limit = int(member_inflight_limit or 0)
+        # placement-wait EWMA (the batcher's admission signal, one
+        # tier up): an autoscaler reads it as its load-rising input
+        self.place_wait_ewma = 0.0
+        self._sheds = 0            # router-local sheds (quota refusals)
+        self._waiters = {}         # priority -> placement waiters
+        self._autoscaler = None    # attached FleetAutoscaler, or None
         if metrics_interval_ms is None:
             metrics_interval_ms = _config.get_flag(
                 "fleet_metrics_interval_ms")
@@ -305,6 +410,21 @@ class FleetRouter:
                 windows=slo_windows,
                 source=_slo.local_source(
                     histogram="paddle_fleet_request_ms"))
+            if self._tenants:
+                # one tracker per NAMED tenant, each reading only its
+                # own labeled children — a bursting tenant burns its
+                # own budget, the victim's verdict stays green
+                for tid, entry in sorted(self._tenants.items()):
+                    self._tenant_slos[tid] = _slo.SLOTracker(
+                        label=entry.label,
+                        target_p99_ms=float(slo_target_p99_ms),
+                        windows=slo_windows,
+                        source=_slo.labeled_source(
+                            histogram="paddle_fleet_tenant_request_ms",
+                            bad_counters=(
+                                "paddle_serving_tenant_shed_total",
+                                "paddle_fleet_tenant_deadline_total"),
+                            label="tenant", value=entry.label))
         self._members = {}          # member id -> _Member
         self._generation = 0
         self._member_seq = itertools.count()
@@ -353,6 +473,11 @@ class FleetRouter:
     def generation(self):
         return self._generation
 
+    @property
+    def label(self):
+        """The router's metric-namespace label ("f<rid>")."""
+        return "f%d" % self._rid
+
     def _gauge(self, which):
         label = "f%d" % self._rid
         fam = _GENERATION if which == "generation" else _MEMBERS_LIVE
@@ -372,6 +497,51 @@ class FleetRouter:
     def member_versions(self):
         with self._lock:
             return {m.id: m.version for m in self._live_locked()}
+
+    def member_loads(self):
+        """{member id: inflight} for members in the routing rotation
+        (the autoscaler's idle-detection input)."""
+        with self._lock:
+            return {m.id: m.inflight for m in self._members.values()
+                    if m.state in ("live", "canary")}
+
+    def shed_signal(self):
+        """Cumulative fleet-wide sheds: router-local quota refusals
+        plus the aggregated worker-side shed counter (only non-zero
+        when members ship snapshots) — the autoscaler's shed-rate
+        input."""
+        return float(self._sheds) + self._aggregator.counter_value(
+            "paddle_serving_shed_total")
+
+    def attach_autoscaler(self, scaler):
+        """Attach (or detach, with None) the capacity controller the
+        monitor loop ticks. The router never constructs one — default
+        flags construct no autoscaler, and the monitor's gate is one
+        attribute-is-None check."""
+        self._autoscaler = scaler
+
+    def retire_member(self, mid, drain_timeout=10.0, stop_timeout=5.0):
+        """Drain ``mid`` and take it out of the fleet — the scale-down
+        path (also an operator verb): stop routing new work to it,
+        wait out its in-flight requests, send ``stop`` (a subprocess
+        worker's serve_forever unblocks, closes, and unregisters), and
+        force-drop if the worker doesn't surrender its lease in time.
+        Not a death: no death counter, no flight dump. Returns False
+        when the member is unknown or already dead."""
+        with self._lock:
+            m = self._members.get(mid)
+            if m is None or m.state == "dead":
+                return False
+        self._drain_member(m, drain_timeout)
+        self._member_call(m, {"cmd": "stop"}, timeout=stop_timeout)
+        deadline = time.monotonic() + stop_timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if m.state == "dead":
+                    return True
+            time.sleep(0.02)
+        self._drop_member(mid, reason="retired", death=False)
+        return True
 
     def fleet_doc(self):
         """The ``/debug/fleet`` document: membership, generation,
@@ -400,6 +570,18 @@ class FleetRouter:
                 "closed": self._closed,
                 "members": members,
             }
+            if self._tenants is not None:
+                doc["tenants"] = {
+                    t.id: {"quota": t.quota, "priority": t.priority,
+                           "active": t.active, "sheds": t.sheds}
+                    for t in self._tenants.values()}
+                doc["sheds"] = self._sheds
+            if self.member_inflight_limit:
+                doc["member_inflight_limit"] = \
+                    self.member_inflight_limit
+        scaler = self._autoscaler
+        if scaler is not None:
+            doc["autoscale"] = scaler.doc()
         telemetry = self._aggregator.fleet_doc()
         for mid, tstate in telemetry["members"].items():
             members.setdefault(mid, {"state": "retired"})[
@@ -550,11 +732,25 @@ class FleetRouter:
     def _monitor_loop(self):
         tick = min(0.5, max(0.01, self.heartbeat_timeout / 4.0))
         while not self._monitor_stop.wait(tick):
+            burn = None
             if self.slo is not None:
                 # the tracker is pull-based; the membership monitor is
                 # its clock (verdict() also ticks, so a pull-only
                 # router without a monitor thread still works)
-                self.slo.tick()
+                burn = self.slo.tick()
+                for tracker in self._tenant_slos.values():
+                    tracker.tick()
+            scaler = self._autoscaler
+            if scaler is not None:
+                # the capacity control loop rides the membership
+                # monitor (no thread of its own); its spawns/retires
+                # run on daemon threads, so a wedged launch can never
+                # stall heartbeat reaping
+                try:
+                    scaler.tick(burn=burn)
+                except Exception as exc:
+                    _log.structured("autoscale_tick_error",
+                                    error=repr(exc)[:200])
             now = time.monotonic()
             with self._lock:
                 overdue = [m.id for m in self._members.values()
@@ -604,7 +800,7 @@ class FleetRouter:
 
     # -- request plane ----------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_ms=None, meta=False, seed=None):
+               deadline_ms=None, meta=False, seed=None, tenant=None):
         """Route one generation request over the fleet; returns a
         Future of the generated ids (int64 array), or — with
         ``meta=True`` — of ``{"tokens", "version", "version_start",
@@ -613,7 +809,13 @@ class FleetRouter:
         sampled decode policy on the members; minted here when None —
         ALWAYS, because the router cannot know which policy members
         run, and an unseeded sampled journal could never re-drive
-        bit-identically after a member death."""
+        bit-identically after a member death.
+
+        ``tenant`` names the submitting tenant: with a tenant table
+        armed it is admission-checked against that tenant's quota
+        (:class:`TenantQuotaError` when over — ITS traffic sheds, not
+        the fleet's) and carried end-to-end on every hop's envelope;
+        without a table it rides along for tracing only."""
         if self._closed:
             raise RuntimeError("router is closed")
         prompt = np.asarray(prompt, np.int64).reshape(-1)
@@ -630,14 +832,65 @@ class FleetRouter:
             deadline = time.monotonic() + budget
         req = _FleetRequest(prompt, max_new_tokens, eos_id, deadline,
                             meta,
-                            seed=mint_seed() if seed is None else seed)
+                            seed=mint_seed() if seed is None else seed,
+                            tenant=tenant)
+        if self._tenants is not None:
+            req.tenant_entry = self._admit_tenant(req.tenant)
+        mint_kw = {}
+        if req.tenant is not None:
+            mint_kw["tenant"] = req.tenant
         req.ctx = _rtrace.mint("fleet.submit",
                                prompt_len=int(prompt.size),
-                               router=self._rid)
+                               router=self._rid, **mint_kw)
         _REQUESTS.inc()
         threading.Thread(target=self._serve, args=(req,), daemon=True,
                          name="fleet-request").start()
         return req.future
+
+    def _admit_tenant(self, tenant):
+        """Quota admission against the tenant table (table armed ==
+        caller guaranteed ``self._tenants is not None``). Unknown
+        tenants get a row lazily under the ``"*"`` policy, so every
+        tenant is metered whether or not the operator named it.
+        Raises :class:`TenantQuotaError` — typed, so callers can tell
+        "you are bursting" from "the fleet is full" — and charges the
+        shed to THIS tenant's counters plus the fleet-wide shed total
+        (a quota refusal IS fleet SLO pressure: it feeds the
+        autoscaler's shed-rate signal)."""
+        tid = "default" if tenant is None else str(tenant)
+        with self._lock:
+            entry = self._tenants.get(tid)
+            if entry is None:
+                quota, priority = self._tenant_default
+                entry = _Tenant(tid, quota, priority,
+                                "f%d:%s" % (self._rid, tid))
+                self._tenants[tid] = entry
+            shed = entry.quota > 0 and entry.active >= entry.quota
+            if shed:
+                entry.sheds += 1
+                self._sheds += 1
+            else:
+                entry.active += 1
+                active = entry.active
+        if shed:
+            _sres.SHED.inc()
+            _sres.TENANT_SHED.labels(tenant=entry.label).inc()
+            raise TenantQuotaError(
+                tid, "tenant %r over its in-flight quota (%d)"
+                % (tid, entry.quota))
+        _TENANT_ACTIVE.labels(tenant=entry.label).set(active)
+        return entry
+
+    def _tenant_done(self, req):
+        """Release the admission slot a resolved request held."""
+        entry = req.tenant_entry
+        if entry is None:
+            return
+        req.tenant_entry = None
+        with self._lock:
+            entry.active = max(0, entry.active - 1)
+            active = entry.active
+        _TENANT_ACTIVE.labels(tenant=entry.label).set(active)
 
     def _resolve_ok(self, req):
         toks = req.tokens
@@ -645,6 +898,10 @@ class FleetRouter:
             toks = toks[:-1]
         e2e = time.perf_counter() - req.t_submit
         _REQUEST_MS.observe(e2e * 1e3)
+        if req.tenant_entry is not None:
+            _TENANT_REQUEST_MS.labels(
+                tenant=req.tenant_entry.label).observe(e2e * 1e3)
+        self._tenant_done(req)
         if req.ctx is not None:
             _rtrace.event(req.ctx, "resolve", tokens=len(toks),
                           member=req.member, replays=req.replays,
@@ -659,6 +916,13 @@ class FleetRouter:
             _resolve(req.future, result=arr)
 
     def _resolve_err(self, req, exc):
+        if req.tenant_entry is not None and \
+                isinstance(exc, ServingDeadlineError):
+            # the per-tenant bad count (the global DEADLINE_EXCEEDED
+            # was already charged at the expiry site)
+            _TENANT_DEADLINE.labels(
+                tenant=req.tenant_entry.label).inc()
+        self._tenant_done(req)
         if req.ctx is not None:
             _rtrace.event(req.ctx, "resolveError",
                           error=repr(exc)[:200],
@@ -730,32 +994,69 @@ class FleetRouter:
         window. Least-loaded among eligible (live, breaker closed —
         or a cooldown-elapsed trial when nothing fitting is closed);
         members this request already failed on are last resort; a
-        mid-deploy canary member receives only its traffic fraction."""
-        deadline = time.monotonic() + self.placement_timeout
+        mid-deploy canary member receives only its traffic fraction.
+
+        With a tenant table armed, placement is priority-tiered: a
+        waiter yields while any STRICTLY higher-priority (lower
+        number) waiter is queued, so under contention (a per-member
+        inflight cap, or every breaker open) the high tier places
+        first. No starvation guarantee beyond the waiter's own
+        placement/deadline window — that is what priority means here.
+
+        Every acquisition (and every placement timeout) folds its
+        wait into ``place_wait_ewma`` — the batcher's queue-wait
+        signal one tier up, and the autoscaler's load-rising input."""
+        t_enter = time.monotonic()
+        deadline = t_enter + self.placement_timeout
         if req.deadline is not None:
             deadline = min(deadline, req.deadline)
-        while True:
-            if self._closed:
-                return None
+        prio = (0 if req.tenant_entry is None
+                else req.tenant_entry.priority)
+        with self._lock:
+            self._waiters[prio] = self._waiters.get(prio, 0) + 1
+        try:
+            while True:
+                if self._closed:
+                    return None
+                with self._lock:
+                    behind = any(
+                        p < prio and n > 0
+                        for p, n in self._waiters.items())
+                    m = None if behind else self._pick_locked(req)
+                    if m is not None:
+                        m.inflight += 1
+                        _MEMBER_INFLIGHT.labels(member=m.label).set(
+                            m.inflight)
+                        return m
+                    anyone = bool(self._live_locked())
+                if self._closed and not anyone:
+                    return None
+                if time.monotonic() >= deadline:
+                    return None
+                # a breaker cooldown, a draining member, or a
+                # scale-up registration can make someone eligible in
+                # finite time
+                time.sleep(0.02)
+        finally:
+            wait = time.monotonic() - t_enter
             with self._lock:
-                m = self._pick_locked(req)
-                if m is not None:
-                    m.inflight += 1
-                    _MEMBER_INFLIGHT.labels(member=m.label).set(
-                        m.inflight)
-                    return m
-                anyone = bool(self._live_locked())
-            if self._closed and not anyone:
-                return None
-            if time.monotonic() >= deadline:
-                return None
-            # a breaker cooldown, a draining member, or a scale-up
-            # registration can make someone eligible in finite time
-            time.sleep(0.02)
+                n = self._waiters.get(prio, 1) - 1
+                if n <= 0:
+                    self._waiters.pop(prio, None)
+                else:
+                    self._waiters[prio] = n
+                self.place_wait_ewma += _WAIT_ALPHA * (
+                    wait - self.place_wait_ewma)
 
     def _pick_locked(self, req):
         live = [m for m in self._members.values()
                 if m.state in ("live", "canary")]
+        if self.member_inflight_limit > 0:
+            # a full member is simply not a candidate — the request
+            # queues at the router (measured by place_wait_ewma)
+            # until someone drains or a scale-up joins
+            live = [m for m in live
+                    if m.inflight < self.member_inflight_limit]
         if not live:
             return None
         canary = self._canary
@@ -882,14 +1183,20 @@ class FleetRouter:
                 if req.deadline is not None:
                     remaining_ms = max(
                         1.0, (req.deadline - time.monotonic()) * 1e3)
-                conn.send({"cmd": "generate",
-                           "prompt": req.journal(),
-                           "max_new": req.remaining(),
-                           "eos_id": req.eos_id,
-                           "seed": req.seed,
-                           "deadline_ms": remaining_ms,
-                           "trace_id": None if req.ctx is None
-                           else req.ctx.trace_id})
+                env = {"cmd": "generate",
+                       "prompt": req.journal(),
+                       "max_new": req.remaining(),
+                       "eos_id": req.eos_id,
+                       "seed": req.seed,
+                       "deadline_ms": remaining_ms,
+                       "trace_id": None if req.ctx is None
+                       else req.ctx.trace_id}
+                if req.tenant is not None:
+                    # the tenant rides every hop like the seed: a
+                    # replay lands on the peer still attributed to
+                    # its tenant (worker-side sheds, traces)
+                    env["tenant"] = req.tenant
+                conn.send(env)
                 hop_start = len(req.tokens)
                 while True:
                     msg = conn.recv()
@@ -1219,6 +1526,15 @@ class FleetRouter:
                                          value="f%d" % self._rid)
         if self.slo is not None:
             self.slo.close()
+        for tracker in self._tenant_slos.values():
+            tracker.close()
+        self._tenant_slos = {}
+        if self._tenants is not None:
+            # per-tenant children share the router's label namespace
+            _metrics.REGISTRY.remove_labeled("tenant", prefix=prefix)
+        scaler = self._autoscaler
+        if scaler is not None:
+            scaler.close()   # detaches itself; reaps pending spawns
         from ..observability import health as _health
         _health.unregister_health(self._health_name)
         for kind in ("metrics", "fleet", "slo"):
@@ -1283,7 +1599,15 @@ def _router_slo(ref):
         router = ref()
         if router is None or router.slo is None:
             return None
-        return router.slo.verdict()
+        doc = router.slo.verdict()
+        if router._tenant_slos:
+            # per-tenant verdicts alongside the fleet one: /debug/slo
+            # answers "whose p99 is blown" — the burster's, not the
+            # victim's
+            doc["tenants"] = {
+                tid: tracker.verdict() for tid, tracker
+                in sorted(router._tenant_slos.items())}
+        return doc
     return provider
 
 
@@ -1338,10 +1662,15 @@ class EngineWorker:
         # backend whose submit() predates decode policies (engines,
         # test fakes) must keep working untouched.
         try:
-            self._accepts_seed = "seed" in inspect.signature(
-                backend.submit).parameters
+            params = inspect.signature(backend.submit).parameters
+            self._accepts_seed = "seed" in params
+            # tenant forwarding is gated the same way: a backend that
+            # understands tenants gets the envelope's id (worker-side
+            # shed attribution), older backends keep working untouched
+            self._accepts_tenant = "tenant" in params
         except (TypeError, ValueError, AttributeError):
             self._accepts_seed = False
+            self._accepts_tenant = False
         if self._kind == "engine":
             # the pre-deploy artifact dir IS the first swap's
             # rollback target — without it a failed first push has
@@ -1505,6 +1834,8 @@ class EngineWorker:
             # replay hop so a sampled generation resumes its exact
             # key schedule
             kw["seed"] = int(msg["seed"])
+        if self._accepts_tenant and msg.get("tenant") is not None:
+            kw["tenant"] = str(msg["tenant"])
         try:
             with _rtrace.activate(ctx):
                 fut = self.backend.submit(

@@ -1128,16 +1128,21 @@ class _GenRequest:
                  "future", "deadline", "t_submit", "tokens", "slot",
                  "session_index", "t_last", "t_queued", "replays",
                  "charged", "failed_on", "last_exc", "ctx",
-                 "on_token", "seed")
+                 "on_token", "seed", "tenant")
 
     def __init__(self, prompt, max_new, explicit_budget, eos_id,
-                 deadline, on_token=None, seed=0):
+                 deadline, on_token=None, seed=0, tenant=None):
         self.prompt = prompt
         # the request's decode-RNG seed: minted ONCE at the front
         # door, re-fed on every replay admission — together with the
         # prompt+tokens journal it makes SAMPLED decode exactly as
         # replayable as greedy (serving/decoding)
         self.seed = seed
+        # tenant id forwarded over the fleet envelope (None when the
+        # caller is single-tenant): shed/trace attribution only — the
+        # scheduler's admission math is tenant-blind, quotas live at
+        # the router
+        self.tenant = tenant
         self.max_new = max_new
         # True when the CALLER asked for max_new tokens (placement
         # must find a session able to serve them all); False when the
@@ -1383,7 +1388,7 @@ class GenerationScheduler:
     # -- admission -------------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
                deadline_ms=None, timeout=None, on_token=None,
-               seed=None):
+               seed=None, tenant=None):
         """Enqueue one prompt; returns a Future of its generated ids.
 
         ``max_new_tokens`` is capped by the slot capacity left after
@@ -1398,7 +1403,12 @@ class GenerationScheduler:
         the request's decode-RNG seed under a sampled policy — minted
         fresh when None, pass one explicitly to reproduce a sampled
         generation exactly (the fleet router does, so every failover
-        hop resumes the same trajectory)."""
+        hop resumes the same trajectory). ``tenant``: the submitting
+        tenant's id (the fleet worker forwards the envelope's) —
+        worker-side sheds of tenant-tagged requests charge
+        ``paddle_serving_tenant_shed_total{tenant=...}`` beside the
+        global counter, and the trace carries the id; admission math
+        itself is tenant-blind (quotas are the router's job)."""
         if self._closed:
             raise RuntimeError("scheduler is closed")
         prompt = np.asarray(prompt, np.int64).reshape(-1)
@@ -1441,6 +1451,9 @@ class GenerationScheduler:
                 # latch the estimate high on an idle queue
                 self._wait_ewma *= (1.0 - _WAIT_ALPHA)
                 _sres.SHED.inc()
+                if tenant is not None:
+                    _sres.TENANT_SHED.labels(
+                        tenant=str(tenant)).inc()
                 raise ServingOverloadError(
                     "shed: projected admission wait %.1f ms exceeds "
                     "the %.1f ms deadline budget"
@@ -1449,13 +1462,18 @@ class GenerationScheduler:
         if seed is None:
             seed = mint_seed() if self._sampled else 0
         item = _GenRequest(prompt, max_new, explicit, eos_id, deadline,
-                           on_token=on_token, seed=int(seed))
+                           on_token=on_token, seed=int(seed),
+                           tenant=None if tenant is None
+                           else str(tenant))
         # minted at the front door (one attribute read when off),
         # carried on the item/journal through every queue, session,
         # and replay hop
+        mint_kw = {}
+        if item.tenant is not None:
+            mint_kw["tenant"] = item.tenant
         item.ctx = _rtrace.mint("generation.submit",
                                 prompt_len=int(prompt.size),
-                                max_new=int(max_new))
+                                max_new=int(max_new), **mint_kw)
         try:
             self._q.put(item, block=True, timeout=timeout)
         except queue.Full:
@@ -1463,6 +1481,8 @@ class GenerationScheduler:
             # never entered the system: a rejection storm must not
             # churn real in-flight traces out of the bounded store
             _rtrace.discard(item.ctx)
+            if item.tenant is not None:
+                _sres.TENANT_SHED.labels(tenant=item.tenant).inc()
             raise ServingOverloadError(
                 "generation queue full (%d pending)"
                 % self._q.qsize()) from None

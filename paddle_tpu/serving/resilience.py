@@ -47,6 +47,12 @@ SHED = _metrics.REGISTRY.counter(
     "paddle_serving_shed_total",
     "Requests shed at admission (projected queue wait exceeded the "
     "deadline budget, or injected overload)")
+TENANT_SHED = _metrics.REGISTRY.counter(
+    "paddle_serving_tenant_shed_total",
+    "Requests shed at admission attributed to one tenant (quota "
+    "rejections at the fleet router, plus worker-side sheds of "
+    "tenant-tagged requests) — the per-tenant slice of "
+    "paddle_serving_shed_total", labelnames=("tenant",))
 FAILOVER = _metrics.REGISTRY.counter(
     "paddle_serving_failover_total",
     "Requests re-dispatched to another replica after an execution "

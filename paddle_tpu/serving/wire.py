@@ -27,7 +27,15 @@ router-minted decode ``seed`` (re-fed verbatim on every replay hop so
 sampled generations re-drive bit-identically), and each worker ack
 carries the member's decode-policy fingerprint ``policy`` — the router
 gates replay-journal reuse on it exactly as it gates on the weights
-``version``.
+``version``. PR 18 adds the optional ``tenant`` field under the same
+discipline: stamped once at the router's front door, re-sent on every
+replay hop (the journal lives router-side), absent entirely for
+single-tenant traffic so pre-tenant frames stay byte-identical.
+Control verbs: ``reg``/``hb``/``unreg`` (membership), ``swap``/
+``rollback`` (deploys), ``health``, ``metrics`` (final snapshot
+ship), and ``stop`` — the drain-then-exit verb the autoscaler's
+retire path sends (a subprocess worker's ``serve_forever`` unblocks,
+closes, and unregisters).
 
 Nothing here is constructed by default flags — the module has no
 import-time side effects beyond defining classes.

@@ -741,18 +741,27 @@ class TestDefaultsOffHotPath:
         try:
             fm.register(router, "m0")
             assert [c for c in calls
-                    if c.startswith(("fleet_", "slo_"))] == \
-                ["fleet_canary_fraction", "fleet_members_min",
-                 "fleet_metrics_interval_ms", "slo_target_p99_ms"]
+                    if c.startswith(("fleet_", "slo_", "autoscale_"))] \
+                == ["fleet_canary_fraction", "fleet_members_min",
+                    "fleet_tenants", "fleet_metrics_interval_ms",
+                    "slo_target_p99_ms"]
             # the windows flag is gated behind a nonzero SLO target:
             # defaults never touch it
             assert "slo_windows" not in calls
+            # default routers build no tenant table and attach no
+            # autoscaler (PR 18): the autoscale flags are read only
+            # inside FleetAutoscaler's constructor
+            assert router._tenants is None
+            assert router._autoscaler is None
+            assert not [c for c in calls
+                        if c.startswith("autoscale_")]
             calls.clear()
             out = router.submit([3], max_new_tokens=3,
                                 meta=True).result(timeout=10)
             assert len(out["tokens"]) == 3
             assert not [c for c in calls
-                        if c.startswith(("fleet_", "slo_"))]
+                        if c.startswith(("fleet_", "slo_",
+                                         "autoscale_"))]
         finally:
             router.close()
             fm.close()

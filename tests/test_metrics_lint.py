@@ -88,6 +88,32 @@ class TestViolations:
             'REGISTRY.gauge("paddle_x_total", "h")\n')
         assert len(probs) == 1 and "multiple kinds" in probs[0]
 
+    def test_labelnames_conflict(self, tmp_path):
+        probs = _scan_src(
+            tmp_path,
+            'REGISTRY.counter("paddle_x_total", "h",\n'
+            '                 labelnames=("tenant",))\n'
+            'REGISTRY.counter("paddle_x_total", "h")\n')
+        assert len(probs) == 1
+        assert "conflicting labelnames" in probs[0]
+
+    def test_same_labelnames_twice_is_fine(self, tmp_path):
+        probs = _scan_src(
+            tmp_path,
+            'REGISTRY.counter("paddle_x_total", "h",\n'
+            '                 labelnames=("a", "b"))\n'
+            'REGISTRY.counter("paddle_x_total", "h",\n'
+            '                 labelnames=("a", "b"))\n')
+        assert probs == []
+
+    def test_dynamic_labelnames_flagged(self, tmp_path):
+        probs = _scan_src(
+            tmp_path,
+            'REGISTRY.counter("paddle_x_total", "h",\n'
+            '                 labelnames=LABELS)\n')
+        assert len(probs) == 1
+        assert "labelnames are not statically resolvable" in probs[0]
+
     def test_unrelated_methods_ignored(self, tmp_path):
         probs = _scan_src(
             tmp_path,

@@ -1,0 +1,271 @@
+"""Autoscaling chaos probe: a bursty two-tenant trace through
+scale-up, a mid-burst member SIGKILL, a mid-burst rolling deploy, and
+scale-down back to baseline — headless, self-asserting.
+
+The capacity-plane counterpart of ``tools/fleet_chaos_probe.py``: one
+baseline engine-worker process behind a :class:`FleetRouter` with a
+tenant table ({burst: quota 3, priority 1} / {victim: unlimited,
+priority 0}) and an attached :class:`FleetAutoscaler` (min 1, max 3)
+ticked by the router's own monitor loop. Then:
+
+* **burst** — four burster threads flood past the quota while one
+  victim thread sends a steady trickle. Quota refusals land on the
+  burster as typed :class:`TenantQuotaError` (ITS traffic sheds) and
+  feed the autoscaler's shed-rate signal alongside the rising
+  placement-wait EWMA; the controller spawns REAL worker processes
+  (warm persistent compile cache) that join through the normal
+  REG/generation discipline;
+* **SIGKILL mid-burst** — once a spawned member has joined, the
+  baseline member is SIGKILLed with requests in flight. Its journals
+  re-drive on the survivors: zero client-visible errors for EITHER
+  tenant;
+* **rolling deploy mid-burst** — a good push rolls through the fleet
+  under the same traffic (canary then commit), still zero client
+  errors;
+* **drain** — the burst ends, members idle out, and the controller
+  retires its spawns one cooldown apart until the fleet is back at
+  ``members_min``.
+
+Invariants asserted: zero client errors end to end, victim-tenant
+shed count EXACTLY 0 while the burster shed (isolation), at least one
+shed/burn-triggered scale-up, the victim's per-tenant SLO verdict not
+alerting, and the final member count back at baseline. Prints each
+phase as JSON and a final OK line; exits non-zero on any break.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/autoscale_chaos_probe.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+import numpy as np  # noqa: E402
+
+import fleet_worker_child as child  # noqa: E402
+
+BURST_THREADS = 4
+MAX_NEW = 6
+
+
+def counter(name, **labels):
+    from paddle_tpu.observability import metrics
+    total = 0.0
+    for s in metrics.REGISTRY.dump().get(name, {}).get("samples", ()):
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            total += s["value"]
+    return total
+
+
+def main():
+    from paddle_tpu.serving.autoscale import FleetAutoscaler
+    from paddle_tpu.serving.fleet import FleetRouter, TenantQuotaError
+
+    tmp = tempfile.mkdtemp(prefix="autoscale_probe_")
+    cache_dir = os.path.join(tmp, "compile_cache")
+
+    print("== bring-up: one baseline member, tenant table, "
+          "autoscaler attached ==")
+    scope = child.build_scope(seed=7)
+    np.savez(os.path.join(tmp, "v1.npz"),
+             **child.model_params(scope, 1.01))
+    del scope
+
+    # a generous SLO target (CPU decode is slow, the victim must stay
+    # green): the scale-up trigger here is the SHED-RATE signal —
+    # quota refusals while the placement wait rises
+    router = FleetRouter(heartbeat_timeout_ms=700, replay_attempts=6,
+                         breaker_failures=3,
+                         breaker_cooldown_ms=60000.0,
+                         members_min=1,
+                         slo_target_p99_ms=30000.0,
+                         tenants={"burst": {"quota": 3, "priority": 1},
+                                  "victim": {"quota": 0,
+                                             "priority": 0}},
+                         member_inflight_limit=3)
+    procs = []
+
+    def spawn_proc(mid, *extra):
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "tests", "fleet_worker_child.py"),
+             "--router", "%s:%d" % router.addr, "--member", mid,
+             "--heartbeat-ms", "150", "--compile-cache", cache_dir]
+            + list(extra),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        line = proc.stdout.readline().strip()
+        assert line.startswith("READY"), line
+        procs.append(proc)
+        return proc
+
+    scaler = None
+    try:
+        t0 = time.perf_counter()
+        baseline_proc = spawn_proc("m0")
+        router.wait_members(1, timeout=300)
+        scaler = FleetAutoscaler(
+            router, spawn_proc, members_max=3, burn_threshold=1.0,
+            cooldown_ms=1500.0, idle_ms=2500.0,
+            spawn_timeout_ms=120000.0, spawn_failure_budget=3,
+            member_prefix="as", drain_timeout=30.0)
+        print(json.dumps({"members": router.members_live(),
+                          "bring_up_sec": round(
+                              time.perf_counter() - t0, 1),
+                          "autoscale": {"min": scaler.members_min,
+                                        "max": scaler.members_max}}))
+
+        print("== burst: 4 bursters past quota + 1 steady victim ==")
+        stop = threading.Event()
+        burst_sheds, burst_errors = [], []
+        victim_served, victim_errors = [], []
+
+        def burster(seed):
+            rs = np.random.RandomState(seed)
+            while not stop.is_set():
+                p = [child.BOS] + [int(t) for t in
+                                   rs.randint(2, child.VOCAB, 3)]
+                try:
+                    router.submit(p, max_new_tokens=MAX_NEW,
+                                  eos_id=-1,
+                                  tenant="burst").result(timeout=300)
+                except TenantQuotaError:
+                    burst_sheds.append(1)
+                    time.sleep(0.01)   # refusal is instant: back off
+                except Exception as exc:  # noqa: BLE001
+                    burst_errors.append(repr(exc))
+
+        def victim():
+            rs = np.random.RandomState(97)
+            while not stop.is_set():
+                p = [child.BOS] + [int(t) for t in
+                                   rs.randint(2, child.VOCAB, 3)]
+                try:
+                    victim_served.append(router.submit(
+                        p, max_new_tokens=MAX_NEW, eos_id=-1,
+                        tenant="victim").result(timeout=300))
+                except Exception as exc:  # noqa: BLE001
+                    victim_errors.append(repr(exc))
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=burster, args=(41 + i,),
+                                    daemon=True)
+                   for i in range(BURST_THREADS)]
+        threads.append(threading.Thread(target=victim, daemon=True))
+        for t in threads:
+            t.start()
+
+        # the monitor-owned control loop must spawn under pressure
+        t_up0 = time.perf_counter()
+        deadline = time.monotonic() + 300
+        while len(router.members_live()) < 2:
+            assert time.monotonic() < deadline, \
+                "autoscaler never scaled up under burst pressure"
+            assert not scaler.halted, scaler.doc()
+            time.sleep(0.1)
+        scale_up_sec = time.perf_counter() - t_up0
+        peak_members = router.members_live()
+        print(json.dumps({"scaled_up_to": peak_members,
+                          "scale_up_sec": round(scale_up_sec, 1),
+                          "scale_ups": counter(
+                              "paddle_autoscale_scale_ups_total")}))
+
+        print("== SIGKILL the baseline member mid-burst ==")
+        baseline_proc.kill()
+        deadline = time.monotonic() + 30
+        while "m0" in router.members_live() and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert "m0" not in router.members_live(), \
+            "dead member never reaped"
+        print(json.dumps({"members_after_kill":
+                          router.members_live()}))
+
+        print("== rolling deploy mid-burst ==")
+        deploy = router.rolling_deploy(
+            params_path=os.path.join(tmp, "v1.npz"), tag="v1",
+            canary_requests=2, watch_timeout=300)
+        assert deploy.get("ok"), deploy
+        # keep the burst alive until the controller has refilled the
+        # killed capacity (the kill dropped the fleet back to min —
+        # the drain phase needs something to retire)
+        deadline = time.monotonic() + 300
+        while len(router.members_live()) < 2:
+            assert time.monotonic() < deadline, \
+                "autoscaler never refilled the killed member"
+            assert not scaler.halted, scaler.doc()
+            time.sleep(0.1)
+        print(json.dumps({"refilled_to": router.members_live()}))
+        stop.set()
+        for t in threads:
+            t.join(timeout=300)
+
+        victim_label = "f%d:victim" % router._rid
+        burst_label = "f%d:burst" % router._rid
+        victim_shed_count = counter(
+            "paddle_serving_tenant_shed_total", tenant=victim_label)
+        burst_shed_count = counter(
+            "paddle_serving_tenant_shed_total", tenant=burst_label)
+        verdicts = {tid: tracker.verdict()
+                    for tid, tracker in
+                    sorted(router._tenant_slos.items())}
+        print(json.dumps({
+            "victim": {"served": len(victim_served),
+                       "errors": victim_errors,
+                       "sheds": victim_shed_count,
+                       "alerting": verdicts["victim"]["alerting"]},
+            "burster": {"quota_sheds": len(burst_sheds),
+                        "shed_counter": burst_shed_count,
+                        "errors": burst_errors},
+            "deploy_ok": deploy.get("ok"),
+        }, indent=1))
+        assert not victim_errors, victim_errors
+        assert not burst_errors, burst_errors
+        assert victim_served, "victim starved"
+        assert burst_sheds, "burster never hit its quota"
+        assert victim_shed_count == 0.0, victim_shed_count
+        assert burst_shed_count >= len(burst_sheds)
+        assert not verdicts["victim"]["alerting"], verdicts["victim"]
+
+        print("== drain: idle members retire back to members_min ==")
+        deadline = time.monotonic() + 120
+        while len(router.members_live()) > scaler.members_min:
+            assert time.monotonic() < deadline, \
+                "fleet never drained back to baseline: %r" \
+                % router.members_live()
+            time.sleep(0.2)
+        final = router.members_live()
+        print(json.dumps({
+            "final_members": final,
+            "scale_downs": counter(
+                "paddle_autoscale_scale_downs_total"),
+            "spawn_failures": counter(
+                "paddle_autoscale_spawn_failures_total"),
+            "autoscale_doc": scaler.doc()}))
+        assert len(final) == scaler.members_min
+        assert counter("paddle_autoscale_scale_downs_total") >= 1
+        assert not scaler.halted
+
+        print("AUTOSCALE CHAOS PROBE OK")
+        return 0
+    finally:
+        if scaler is not None:
+            scaler.close()
+        router.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,7 +11,12 @@ must register a name that is
 * snake_case (``[a-z0-9_]``, no leading/trailing/double underscores),
 * registered with a single help text — the same name re-registered
   elsewhere must carry the identical help string (the registry keeps
-  the first; a silently differing duplicate is drift).
+  the first; a silently differing duplicate is drift),
+* registered with ONE labelnames tuple — families are immutable once
+  registered, so the same name declared with different labels in two
+  modules (say ``paddle_serving_tenant_shed_total{tenant}`` here,
+  unlabeled there) only explodes at runtime when both import; this
+  catches it statically.
 
 Wired as a tier-1 test (tests/test_metrics_lint.py) and runnable
 standalone:
@@ -48,6 +53,23 @@ def _literal_str(node, consts):
         return node.value
     if isinstance(node, ast.Name):
         return consts.get(node.id)
+    return None
+
+
+def _labelnames(call, consts):
+    """The ``labelnames=`` tuple as a tuple of strings; ``()`` when
+    absent (an unlabeled family); None when present but not a static
+    tuple/list of string literals."""
+    node = None
+    for kw in call.keywords:
+        if kw.arg == "labelnames":
+            node = kw.value
+    if node is None:
+        return ()
+    if isinstance(node, (ast.Tuple, ast.List)):
+        names = [_literal_str(e, consts) for e in node.elts]
+        if all(n is not None for n in names):
+            return tuple(names)
     return None
 
 
@@ -117,8 +139,13 @@ def scan_file(path, registrations, problems):
                             % (where, name))
             continue
         help_text = _help_text(node, consts)
+        labels = _labelnames(node, consts)
+        if labels is None:
+            problems.append(
+                "%s: metric %r labelnames are not statically "
+                "resolvable" % (where, name))
         registrations.setdefault(name, []).append(
-            (where, help_text, fn.attr))
+            (where, help_text, fn.attr, labels))
 
 
 def check(root):
@@ -133,18 +160,24 @@ def check(root):
                     scan_file(os.path.join(dirpath, fn),
                               registrations, problems)
     for name, sites in sorted(registrations.items()):
-        helps = {h for _w, h, _k in sites if h is not None}
+        helps = {h for _w, h, _k, _l in sites if h is not None}
         if len(helps) > 1:
             problems.append(
                 "metric %r registered with %d different help texts: %s"
                 % (name, len(helps),
-                   "; ".join(w for w, _h, _k in sites)))
-        kinds = {k for _w, _h, k in sites}
+                   "; ".join(w for w, _h, _k, _l in sites)))
+        kinds = {k for _w, _h, k, _l in sites}
         if len(kinds) > 1:
             problems.append(
                 "metric %r registered as multiple kinds %s: %s"
                 % (name, sorted(kinds),
-                   "; ".join(w for w, _h, _k in sites)))
+                   "; ".join(w for w, _h, _k, _l in sites)))
+        labelsets = {l for _w, _h, _k, l in sites if l is not None}
+        if len(labelsets) > 1:
+            problems.append(
+                "metric %r registered with conflicting labelnames "
+                "%s: %s" % (name, sorted(labelsets),
+                            "; ".join(w for w, _h, _k, _l in sites)))
     return problems
 
 
