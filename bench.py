@@ -660,7 +660,12 @@ def bench_generation(on_accel):
 
     Latency metrics carry ``higher_is_better: false`` plus a noise
     floor (like ``swap_blackout_ms``): CPU scheduler jitter at the
-    millisecond scale must not trip the wire."""
+    millisecond scale must not trip the wire.
+
+    Each decode line is stamped with the ``compute_dtype`` it ran
+    under (like PR 17's ``policy`` stamp); the ``_int8`` variants
+    re-measure the same workload with ``serving_quant_compute`` armed
+    — int8 weights through the MXU, no per-step dequantization."""
     import paddle_tpu as ptpu
     from paddle_tpu import layers
     from paddle_tpu.models.transformer import (transformer_lm_generate,
@@ -723,6 +728,36 @@ def bench_generation(on_accel):
             "generation shape set not closed: %d compiles for 1 "
             "prompt bucket + 1 decode shape" % stats["compiles"])
 
+    # int8 re-measure (ISSUE 19): arm serving_quant_compute on the SAME
+    # weights — the session quantizes the scope in place, so this runs
+    # only after every f32 window above has closed
+    ptpu.config.set_flags(serving_quant_compute=True)
+    try:
+        spec8 = transformer_lm_session(vocab, max_len=max_len,
+                                       slots=slots, cache_len=max_len,
+                                       prompt_buckets=(8,), **kw)
+        sess8 = GenerationSession(spec8)
+        if not sess8._quant_armed:
+            raise RuntimeError("int8 compute did not arm any weights")
+        for _ in range(slots):
+            sess8.admit(list(rs.randint(2, vocab, 4)))
+        sess8.step()          # warm: prefill + int8 decode compiles
+        step8_ms = []
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            t1 = time.perf_counter()
+            sess8.step()
+            step8_ms.append((time.perf_counter() - t1) * 1e3)
+        dt8 = time.perf_counter() - t0
+        tok8_per_sec = slots * steps / dt8
+        if sess8.compile_stats()["compiles"] != 2:
+            raise RuntimeError(
+                "int8 generation shape set not closed: %d compiles"
+                % sess8.compile_stats()["compiles"])
+        sess8.close()
+    finally:
+        ptpu.config.set_flags(serving_quant_compute=False)
+
     return [{
         "metric": "decode_tokens_per_sec" + suffix,
         "value": round(tok_per_sec, 1),
@@ -731,6 +766,26 @@ def bench_generation(on_accel):
         "slots": slots,
         "steps": steps,
         "policy": "greedy",  # decode-policy the line was measured under
+        "compute_dtype": "float32",  # matmul dtype the line ran under
+    }, {
+        "metric": "decode_tokens_per_sec_int8" + suffix,
+        "value": round(tok8_per_sec, 1),
+        "unit": "tokens/sec (aggregate, %d slots, int8 weights)"
+                % slots,
+        "vs_baseline": 1.0,
+        "slots": slots,
+        "steps": steps,
+        "policy": "greedy",
+        "compute_dtype": "int8",
+    }, {
+        "metric": "inter_token_ms_int8" + suffix,
+        "value": round(float(np.median(step8_ms)), 2),
+        "unit": "ms per decode step (all slots, int8 weights)",
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        "regression_floor": 2.0,
+        "policy": "greedy",
+        "compute_dtype": "int8",
     }, {
         "metric": "time_to_first_token_ms" + suffix,
         "value": round(float(np.median(ttft)), 2),
@@ -749,6 +804,7 @@ def bench_generation(on_accel):
         "vs_baseline": 1.0,
         "regression_floor": 2.0,
         "policy": "greedy",
+        "compute_dtype": "float32",
     }]
 
 
@@ -861,7 +917,10 @@ def bench_paged_kv(on_accel):
     * ``prefix_cache_hit_rate`` — prompt tokens served from cached
       prefix blocks / total prompt tokens submitted. Higher is
       better; on the shared-system-prompt workload the common prefix
-      should prefill exactly once."""
+      should prefill exactly once.
+    * ``kv_cache_bytes_per_token_bf16`` — the same workload under
+      ``generation_kv_dtype=bfloat16`` (ISSUE 19); must hold at half
+      the f32 line."""
     import paddle_tpu as ptpu
     from paddle_tpu import layers
     from paddle_tpu.models.transformer import (transformer_lm_generate,
@@ -915,6 +974,32 @@ def bench_paged_kv(on_accel):
     sess.check_pool_invariant()
     sess.close()
 
+    # bf16 block pools (ISSUE 19): same workload under
+    # generation_kv_dtype — bytes/token must track at half the f32
+    # line (greedy-token parity is asserted in tests, not here)
+    ptpu.config.set_flags(generation_kv_dtype="bfloat16")
+    try:
+        spec_bf = transformer_lm_session(
+            vocab, max_len=max_len, slots=slots, cache_len=cache_len,
+            prompt_buckets=(8, 16), paged=True, block_size=block_size,
+            prefix_cache=True, **kw)
+        sess_bf = GenerationSession(spec_bf)
+        sess_bf.generate(system + [2], max_new_tokens=4, eos_id=-1)
+        live_bf = [sess_bf.admit(system + [3 + i])[0]
+                   for i in range(slots)]
+        for _ in range(8):
+            sess_bf.step()
+        live_tokens_bf = int(sess_bf.lengths[live_bf].sum())
+        pstats_bf = sess_bf.pool_stats()
+        bf_bytes = pstats_bf["blocks_in_use"] \
+            * pstats_bf["bytes_per_block"]
+        for s in live_bf:
+            sess_bf.retire(s)
+        sess_bf.check_pool_invariant()
+        sess_bf.close()
+    finally:
+        ptpu.config.set_flags(generation_kv_dtype=None)
+
     return [{
         "metric": "kv_cache_bytes_per_token" + suffix,
         "value": round(paged_bytes / live_tokens, 1),
@@ -925,6 +1010,17 @@ def bench_paged_kv(on_accel):
         "dense_equiv_bytes_per_token": round(
             dense_bytes / live_tokens, 1),
         "pool_blocks_in_use": pstats["blocks_in_use"],
+        "block_size": block_size,
+        "kv_dtype": "float32",
+    }, {
+        "metric": "kv_cache_bytes_per_token_bf16" + suffix,
+        "value": round(bf_bytes / live_tokens_bf, 1),
+        "unit": "cache bytes pinned per live token (bf16 block pool, "
+                "shared-prefix workload)",
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        "kv_dtype": "bfloat16",
+        "f32_bytes_per_token": round(paged_bytes / live_tokens, 1),
         "block_size": block_size,
     }, {
         "metric": "prefix_cache_hit_rate" + suffix,
@@ -1447,7 +1543,9 @@ def bench_recsys(on_accel):
     all_to_all inside the jitted step. Emits two tripwire metrics:
     ``recsys_examples_per_sec`` (end-to-end train throughput) and
     ``embedding_lookup_rows_per_sec`` (ids resolved through the
-    distributed tables per second — both tables count).
+    distributed tables per second — both tables count), plus the
+    static ``embedding_a2a_bytes_per_step`` exchange-volume lines for
+    the f32 and int8 wires (ISSUE 19).
 
     Defaults-off contract: the embedding flags must arrive False here
     (the subsystem is constructed only inside this bench's flag
@@ -1530,6 +1628,21 @@ def bench_recsys(on_accel):
     ex_per_sec = batch * steps / elapsed
     # two distributed tables (deep + wide) each resolve batch*slots ids
     rows_per_sec = 2 * batch * slots * steps / elapsed
+
+    # static per-step lookup exchange volume (ISSUE 19): the two-hop
+    # route's bytes are a function of batch geometry and wire dtype,
+    # not runtime — same formula the subsystem's telemetry uses.
+    # Summed over the deep (emb_dim) and wide (dim 1) tables; the int8
+    # wire ships int8 rows plus one f32 scale per row
+    from paddle_tpu.embeddings.sharded import a2a_step_bytes
+    total = batch * slots
+    f32_step = int8_step = 0
+    for dim in (emb_dim, 1):
+        ids_b, rows_b = a2a_step_bytes(total, dim, shards, itemsize=4)
+        f32_step += ids_b + rows_b
+        ids8, rows8 = a2a_step_bytes(total, dim, shards, itemsize=1)
+        int8_step += ids8 + rows8 + shards * total * 4
+
     common = {"unit_note": "%d-shard tables, vocab %d, %d slots"
               % (shards, vocab, slots), "num_shards": shards,
               "batch": batch, "steps": steps}
@@ -1540,6 +1653,21 @@ def bench_recsys(on_accel):
         dict({"metric": "embedding_lookup_rows_per_sec" + suffix,
               "value": round(rows_per_sec, 1),
               "unit": "rows/sec"}, **common),
+        dict({"metric": "embedding_a2a_bytes_per_step" + suffix,
+              "value": f32_step,
+              "unit": "bytes exchanged per step (f32 wire, both "
+                      "tables)",
+              "higher_is_better": False,
+              "vs_baseline": 1.0,
+              "wire_dtype": "float32"}, **common),
+        dict({"metric": "embedding_a2a_bytes_per_step_int8" + suffix,
+              "value": int8_step,
+              "unit": "bytes exchanged per step (int8 wire + f32 "
+                      "row scales, both tables)",
+              "higher_is_better": False,
+              "vs_baseline": 1.0,
+              "wire_dtype": "int8",
+              "f32_wire_bytes": f32_step}, **common),
     ]
 
 

@@ -349,6 +349,55 @@ embedding_a2a: if True (and ``embedding_shard_rows`` is sharding), the
   (default): the gather goes through the mod layout as a global-view
   take and GSPMD chooses the collectives. Same numerics either way;
   same read discipline as embedding_shard_rows.
+
+embedding_wire_dtype: payload dtype of the a2a ROW hop (the return
+  leg of the two-hop lookup). "int8": rows are quantized shard-side
+  (symmetric per-row amax/127 scale), the int8 rows plus one f32
+  scale per row cross the wire, and the receiver dequantizes after
+  the return hop — ~3.9x fewer row-payload bytes per step (the
+  gradient hop stays f32: training cotangents are not forward
+  activations). None (default): f32 rows, byte-identical route.
+  Trace-time for DistEmbedding programs only (read inside the a2a
+  lookup's _trace_mode and keyed into the executor compile cache);
+  plain programs never read it.
+
+serving_quant_compute: if True, serving consumers run int8-exported
+  weights AS int8 on device — ``ServingEngine`` asks
+  ``load_inference_model`` to skip the f32 dequantize copy, and
+  ``GenerationSession`` quantizes its programs' eligible weights in
+  place at construction (serving/quant.py arm/install); matmul/conv
+  ops on those weights then take the int8 x int8 -> int32 MXU path
+  with the per-output-channel scale fused into the f32 epilogue
+  (ops/quant_ops.py). False (default): int8 artifacts dequantize at
+  load exactly as before. Read only at engine/session construction;
+  the executor gates per program on one getattr, zero flag reads.
+
+quant_pallas: route the quantized DECODE matmul through the fused
+  Pallas dequant-matmul kernel (ops/quant_ops.py) instead of the
+  dense XLA int8 path. Same numerics bit-for-bit (the int8 dot is
+  exact in int32 and the f32 epilogue expression is shared); the
+  kernel fuses activation-quantize + int8 dot + scale epilogue into
+  one VMEM pass. Read only where serving_quant_compute arms a
+  program (construction); stored on the program tag, so the trace
+  itself reads no flags.
+
+generation_kv_dtype: dtype of the generation K/V cache storage —
+  dense rows and paged block pools both. "bfloat16": cache writes
+  round to bf16 and attention reads upcast to f32 (halves
+  kv_cache_bytes_per_token, doubling fixed-budget paged
+  concurrency). None (default): caches stay f32, byte-identical.
+  Read only inside ``transformer_lm_session`` at spec construction
+  (and only when the caller left ``dtype`` at its default);
+  rebuilds inherit the resolved dtype without re-reading.
+
+fused_conv_bn: if True, ``models.resnet.conv_bn_layer`` emits the
+  fused ``conv2d_bn`` op (ops/pallas_conv_bn.py) — conv and the BN
+  batch moments in ONE kernel pass (Pallas epilogue accumulates
+  per-channel sum/sumsq as the conv output is produced), so the
+  bandwidth-bound ResNet step writes activations once instead of
+  re-reading the conv output for the moments. False (default): the
+  separate conv2d + batch_norm ops, byte-identical. Read only at
+  model construction.
 """
 
 import jax
@@ -454,6 +503,14 @@ _flags = {
     # the subsystem and plain programs never read these)
     "embedding_shard_rows": False,
     "embedding_a2a": False,
+    # quantized COMPUTE (ops/quant_ops.py, serving/quant.py arm/install;
+    # read only at engine/session/model construction — defaults keep
+    # every artifact load, decode program, and a2a route byte-identical)
+    "embedding_wire_dtype": None,
+    "serving_quant_compute": False,
+    "quant_pallas": False,
+    "generation_kv_dtype": None,
+    "fused_conv_bn": False,
 }
 
 # Observers called with the flag dict after every set_flags (the

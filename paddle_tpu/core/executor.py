@@ -183,10 +183,15 @@ def _amp_cast(op_type, val, amp_dtype):
 class _TraceState:
     """Per-trace mutable state shared across ops in one block execution."""
 
-    def __init__(self, needs_vjp, nan_guards=None, amp=None):
+    def __init__(self, needs_vjp, nan_guards=None, amp=None, quant=None):
         self.vjp_cache = {}   # id(fwd_op) -> (vjp_fn, flat_out_values)
         self.needs_vjp = needs_vjp
         self.amp = jnp.dtype(amp) if amp else None
+        # When not None: the program's _quant_compute tag (serving/quant.py
+        # arm/install) — {"vars": {weight_name: axis}, "pallas": bool,
+        # "key": hashable}. Forward mul/matmul/conv2d consult
+        # ops/quant_ops.maybe_quant_compute for the int8 path.
+        self.quant = quant
         # When not None: dict collecting per-op finiteness predicates
         # ("op#i:type:var" -> scalar bool). The reference scans every op's
         # outputs under FLAGS_check_nan_inf (framework/executor.cc:120-128);
@@ -250,6 +255,14 @@ def _execute_forward_op(op, env, block, trace):
             if i < len(names) and val is not None and names[i] != EMPTY_VAR:
                 env[names[i]] = val
     else:
+        if trace.quant is not None and op.type in ("mul", "matmul",
+                                                   "conv2d"):
+            from ..ops import quant_ops as _quant_ops
+            result = _quant_ops.maybe_quant_compute(op, values, env, trace)
+            if result is not None:
+                _write_outputs(op, env,
+                               registry.normalize_outputs(op, result))
+                return
         if amp and (op.type in AMP_WHITE or op.type in AMP_BLACK):
             values = {slot: [_amp_cast(op.type, v, amp) for v in lst]
                       for slot, lst in values.items()}
@@ -436,14 +449,20 @@ class Executor:
         if emb_tables:
             emb_key = (bool(_config.get_flag("embedding_shard_rows")),
                        bool(_config.get_flag("embedding_a2a")),
-                       telemetry)
+                       telemetry,
+                       _config.get_flag("embedding_wire_dtype"))
+        # int8 quantized compute: armed programs carry their tag
+        # (serving/quant.py); the default path pays one getattr, zero
+        # flag reads
+        quant = getattr(program, "_quant_compute", None)
+        q_key = quant["key"] if quant else None
         # every trace-time flag must key the compile cache; the ingest
         # prologue (wire widening + packed unpack) is trace-time too
         key = (program._uid, program._version, feed_sig, tuple(fetch_names),
                bool(donate_state),
                self.strategy._uid if self.strategy is not None else None,
                check_nan_inf, amp, flash, precision, nonfinite_guard,
-               ingest_specs, emb_key)
+               ingest_specs, emb_key, q_key)
         entry = self._cache.get(key)
         if entry is None:
             self._compiles += 1
@@ -451,7 +470,8 @@ class Executor:
                 _CACHE_MISSES.inc()
             built = self._build(program, block, feed_sig, fetch_names,
                                 donate_state, check_nan_inf, amp,
-                                nonfinite_guard, ingest_specs, packed_sig)
+                                nonfinite_guard, ingest_specs, packed_sig,
+                                quant)
             entry = _CacheEntry(*built, key_id="k%d" % next(_KEY_IDS))
             # the process-stable half of the persistent-cache digest
             # (key[2:] drops program uid/version, which the program's
@@ -723,10 +743,17 @@ class Executor:
 
     def _build(self, program, block, feed_sig, fetch_names, donate_state,
                check_nan_inf=False, amp=None, nonfinite_guard=False,
-               ingest_specs=(), packed_sig=None):
+               ingest_specs=(), packed_sig=None, quant=None):
         read, written, needs_rng = _block_io(block)
         if needs_rng:
             written.add(RNG_STATE_VAR)
+        if quant:
+            # the per-channel scale sidecars live in the scope but are
+            # not block vars, so _block_io can't see them — thread them
+            # into the read set so state assembly ships them to the trace
+            from ..ops import quant_ops as _quant_ops
+            for _qn in quant["vars"]:
+                read.add(_quant_ops.scale_var_name(_qn))
         needs_vjp = {id(op.attrs["fwd_op"]) for op in block.ops
                      if op.type == "vjp_grad"}
         written_t = tuple(sorted(written))
@@ -759,7 +786,7 @@ class Executor:
             env.update(feed)
             trace = _TraceState(needs_vjp,
                                 nan_guards={} if check_nan_inf else None,
-                                amp=amp)
+                                amp=amp, quant=quant)
             prev = _parallel.set_current_strategy(strategy)
             try:
                 if precision is not None:

@@ -535,7 +535,8 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     write_artifact_manifest(dirname)
 
 
-def load_inference_model(dirname, executor, scope=None):
+def load_inference_model(dirname, executor, scope=None,
+                         quant_compute=False):
     """Returns (program, feed_names, fetch_names). The __model__ file is
     versioned JSON (data only — safe to load from untrusted model dirs,
     unlike pickle; reference ships a protobuf ProgramDesc the same way).
@@ -546,7 +547,13 @@ def load_inference_model(dirname, executor, scope=None):
     manifest-less artifacts load with a one-time warning. ``compiled/``
     members (AOT executables) are NOT loaded here — and note they
     deserialize via pickle, so only ServingEngine consumes them, and
-    only from trusted artifacts."""
+    only from trusted artifacts.
+
+    ``quant_compute=True`` (ServingEngine under the
+    ``serving_quant_compute`` flag): int8-exported weights the compute
+    path can serve stay int8 in the scope — no f32 copy is ever
+    materialized — and the program is tagged for the executor's int8
+    op path; the rest dequantize as usual (serving/quant.py)."""
     orig_path = dirname
     tmp_dir = None
     if os.path.isfile(dirname):
@@ -580,10 +587,13 @@ def load_inference_model(dirname, executor, scope=None):
                     scope=scope)
         # int8-exported weights (quant.json sidecar) dequantize here, so
         # every loader (engines, C API, merged files) is quant-agnostic
+        # — unless the caller armed int8 compute, which keeps them int8
         from .serving import quant as _quant
-        _quant.maybe_dequantize(dirname,
-                                scope if scope is not None
-                                else global_scope())
+        tgt_scope = scope if scope is not None else global_scope()
+        if quant_compute:
+            _quant.install_quant_compute(dirname, program, tgt_scope)
+        else:
+            _quant.maybe_dequantize(dirname, tgt_scope)
     finally:
         if tmp_dir is not None:
             # params land in the scope during load; the unpacked dir

@@ -19,7 +19,8 @@ __all__ = [
     "conv3d_transpose", "factorization_machine", "pool2d",
     "switch_order", "scale_shift", "resize", "kmax_seq_score",
     "scale_sub_region",
-    "pool3d", "batch_norm", "layer_norm", "dropout", "cross_entropy",
+    "pool3d", "batch_norm", "fused_conv_bn", "layer_norm", "dropout",
+    "cross_entropy",
     "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
     "square_error_cost", "accuracy", "auc", "topk", "matmul", "reduce_sum",
     "reduce_mean", "reduce_max", "reduce_min", "reduce_prod", "lrn",
@@ -311,6 +312,68 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                      attrs={"momentum": momentum, "epsilon": epsilon,
                             "is_test": is_test,
                             "data_layout": data_layout})
+    return helper.append_activation(out)
+
+
+def fused_conv_bn(input, num_filters, filter_size, stride=1, padding=0,
+                  dilation=1, groups=1, act=None, is_test=False,
+                  momentum=0.9, epsilon=1e-5, param_attr=None,
+                  bn_param_attr=None, bn_bias_attr=None, name=None,
+                  **kwargs):
+    """conv2d (bias-free) + batch_norm as ONE ``conv2d_bn`` op
+    (ops/pallas_conv_bn.py): the conv output is written once with its
+    batch moments accumulated in the same pass instead of re-read by a
+    separate batch_norm. Parameter/initializer layout matches the
+    unfused pair (conv filter with He init, BN scale/bias, persistable
+    running mean/variance), so checkpoints interchange."""
+    helper = LayerHelper("conv2d_bn", act=act, name=name, **kwargs)
+    num_channels = input.shape[1]
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    padding = [padding, padding] if isinstance(padding, int) \
+        else list(padding)
+    dilation = [dilation, dilation] if isinstance(dilation, int) \
+        else list(dilation)
+    fan_in = num_channels * int(np.prod(filter_size)) // (groups or 1)
+    w = helper.create_parameter(
+        param_attr,
+        shape=[num_filters, num_channels // (groups or 1)] +
+        list(filter_size),
+        dtype=input.dtype,
+        default_initializer=NormalInitializer(0.0,
+                                              float(np.sqrt(2.0 / fan_in))))
+    scale = helper.create_parameter(
+        bn_param_attr, shape=[num_filters], dtype=input.dtype,
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bn_bias_attr, shape=[num_filters],
+                                   dtype=input.dtype, is_bias=True)
+    mean = helper.create_global_variable(
+        shape=[num_filters], dtype=input.dtype, persistable=True,
+        name=helper.name + ".mean" if name else None,
+        initializer=ConstantInitializer(0.0))
+    variance = helper.create_global_variable(
+        shape=[num_filters], dtype=input.dtype, persistable=True,
+        name=helper.name + ".variance" if name else None,
+        initializer=ConstantInitializer(1.0))
+    out = helper.create_tmp_variable(input.dtype)
+    saved_mean = helper.create_tmp_variable(input.dtype,
+                                            stop_gradient=True)
+    saved_var = helper.create_tmp_variable(input.dtype,
+                                           stop_gradient=True)
+    helper.append_op(type="conv2d_bn",
+                     inputs={"Input": [input.name], "Filter": [w.name],
+                             "Scale": [scale.name], "Bias": [bias.name],
+                             "Mean": [mean.name],
+                             "Variance": [variance.name]},
+                     outputs={"Y": [out.name], "MeanOut": [mean.name],
+                              "VarianceOut": [variance.name],
+                              "SavedMean": [saved_mean.name],
+                              "SavedVariance": [saved_var.name]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": groups or 1,
+                            "momentum": momentum, "epsilon": epsilon,
+                            "is_test": is_test})
     return helper.append_activation(out)
 
 

@@ -17,6 +17,15 @@ __all__ = ["resnet_imagenet", "resnet_cifar10"]
 
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
                   is_test=False, name=None):
+    from .. import config as _config
+    if _config.get_flag("fused_conv_bn"):
+        # one conv2d_bn op: the conv output is written once with its
+        # batch moments in the same pass (ops/pallas_conv_bn.py);
+        # construction-time flag read, default-off program unchanged
+        return layers.fused_conv_bn(
+            input, num_filters=ch_out, filter_size=filter_size,
+            stride=stride, padding=padding, act=act, is_test=is_test,
+            name=name)
     conv = layers.conv2d(input, num_filters=ch_out,
                          filter_size=filter_size, stride=stride,
                          padding=padding, act=None, bias_attr=False,

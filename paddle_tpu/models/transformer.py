@@ -282,6 +282,15 @@ def transformer_lm_session(vocab_size, d_model=128, num_heads=4,
         # same scope never collide on cache names while still sharing
         # every parameter name
         cache_ns = _un.generate("kv_session")
+    if dtype == "float32":
+        # bf16 (or other) K/V pools: resolved ONCE here at construction;
+        # the resolved value rides the spec's cache_vars, the draft
+        # spec, and _rebuild — no further flag reads. Params and
+        # activations stay f32; only the cache storage narrows (the
+        # decode kernels/references upcast at the contraction).
+        kvd = _config.get_flag("generation_kv_dtype")
+        if kvd:
+            dtype = str(kvd)
     if paged is None:
         paged = bool(_config.get_flag("generation_paged_kv"))
     max_blocks = 0

@@ -26,4 +26,5 @@ from . import (  # noqa: F401
     detection_ops,
     misc_ops,
     legacy_tail_ops,
+    pallas_conv_bn,
 )

@@ -75,7 +75,16 @@ def _body(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, acc_ref, m_ref,
         # default_matmul_precision('BF16_BF16_F32') is a
         # DotAlgorithmPreset that Mosaic's dot lowering rejects; inside
         # the kernel the MXU path is already bf16-multiply/f32-acc
-        s = jnp.dot(q_ref[0], k_ref[0].T,
+        # narrow (bf16) pools upcast at the contraction, matching the
+        # reference's promotion; identity trace for f32 pools, so the
+        # flag-off program stays byte-identical
+        k_blk = k_ref[0]
+        if k_blk.dtype != jnp.float32:
+            k_blk = k_blk.astype(jnp.float32)
+        v_blk = v_ref[0]
+        if v_blk.dtype != jnp.float32:
+            v_blk = v_blk.astype(jnp.float32)
+        s = jnp.dot(q_ref[0], k_blk.T,
                     preferred_element_type=jnp.float32,
                     precision=jax.lax.Precision.DEFAULT) * scale
         mask = None
@@ -105,7 +114,7 @@ def _body(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, acc_ref, m_ref,
         l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=-1,
                                               keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha[:, :1] + jnp.dot(
-            p.astype(v_ref.dtype), v_ref[0],
+            p.astype(v_blk.dtype), v_blk,
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.DEFAULT)
         m_ref[:] = m_new
@@ -294,7 +303,16 @@ def _decode_body(lens_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
 
     @pl.when(live)
     def _step():
-        s = jnp.dot(q_ref[0], k_ref[0].T,
+        # narrow (bf16) pools upcast at the contraction, matching the
+        # reference's promotion; identity trace for f32 pools, so the
+        # flag-off program stays byte-identical
+        k_blk = k_ref[0]
+        if k_blk.dtype != jnp.float32:
+            k_blk = k_blk.astype(jnp.float32)
+        v_blk = v_ref[0]
+        if v_blk.dtype != jnp.float32:
+            v_blk = v_blk.astype(jnp.float32)
+        s = jnp.dot(q_ref[0], k_blk.T,
                     preferred_element_type=jnp.float32,
                     precision=jax.lax.Precision.DEFAULT) * scale
         cols = ki * block_k + jax.lax.broadcasted_iota(
@@ -310,7 +328,7 @@ def _decode_body(lens_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=-1,
                                               keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha[:, :1] + jnp.dot(
-            p.astype(v_ref.dtype), v_ref[0],
+            p.astype(v_blk.dtype), v_blk,
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.DEFAULT)
         m_ref[:] = m_new
@@ -423,7 +441,16 @@ def _decode_paged_body(lens_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(live)
     def _step():
-        s = jnp.dot(q_ref[0], k_ref[0].T,
+        # narrow (bf16) pools upcast at the contraction, matching the
+        # reference's promotion; identity trace for f32 pools, so the
+        # flag-off program stays byte-identical
+        k_blk = k_ref[0]
+        if k_blk.dtype != jnp.float32:
+            k_blk = k_blk.astype(jnp.float32)
+        v_blk = v_ref[0]
+        if v_blk.dtype != jnp.float32:
+            v_blk = v_blk.astype(jnp.float32)
+        s = jnp.dot(q_ref[0], k_blk.T,
                     preferred_element_type=jnp.float32,
                     precision=jax.lax.Precision.DEFAULT) * scale
         cols = ki * block_k + jax.lax.broadcasted_iota(
@@ -439,7 +466,7 @@ def _decode_paged_body(lens_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=-1,
                                               keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha[:, :1] + jnp.dot(
-            p.astype(v_ref.dtype), v_ref[0],
+            p.astype(v_blk.dtype), v_blk,
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.DEFAULT)
         m_ref[:] = m_new

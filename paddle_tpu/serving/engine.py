@@ -181,9 +181,14 @@ class ServingEngine:
                 unpack_merged_model(model_dir)
         self._artifact_dir = artifact_dir
         try:
+            # construction-time flag read: int8 artifacts serve their
+            # weights AS int8 through the MXU (serving/quant.py)
+            quant_compute = bool(
+                _config.get_flag("serving_quant_compute"))
             (self.program, self.feed_names,
              self.fetch_names) = _io.load_inference_model(
-                 artifact_dir, exe0, scope=scope0)
+                 artifact_dir, exe0, scope=scope0,
+                 quant_compute=quant_compute)
             # the exact variable set an artifact loads — the
             # shape/dtype signature swap_weights validates a new push
             # against

@@ -330,12 +330,36 @@ class GenerationSession:
     proof, asserted in tests and printed by tools/generate_probe.py.
     """
 
-    def __init__(self, spec, scope=None, place=None, draft_scope=None):
+    def __init__(self, spec, scope=None, place=None, draft_scope=None,
+                 arm_quant=None):
         import jax.numpy as jnp
         self.spec = spec
         self.scope = scope if scope is not None else global_scope()
         self.place = place  # kept so a rebuild lands on the same device
         self.exe = Executor(place=place)
+        # -- int8 quantized compute (serving/quant.py) -----------------
+        # construction-time flag read; arming quantizes the scope's
+        # weights in place and tags the programs — idempotent across
+        # _rebuild (weights already int8 + scale sidecars present).
+        # A shared-scope draft's programs MUST join the same arm call
+        # (one scope, one selection), so the nested draft constructor
+        # is told not to re-arm; a separate-scope draft arms itself.
+        if arm_quant is None:
+            arm_quant = bool(_config.get_flag("serving_quant_compute"))
+        self._quant_armed = []
+        if arm_quant:
+            from . import quant as _quant
+            progs = list(spec.prefill_programs.values())
+            progs.append(spec.decode_program)
+            if getattr(spec, "verify_program", None) is not None:
+                progs.append(spec.verify_program)
+            dspec = getattr(spec, "draft_spec", None)
+            shared_draft = dspec is not None and draft_scope is None
+            if shared_draft:
+                progs += list(dspec.prefill_programs.values())
+                progs.append(dspec.decode_program)
+            self._quant_armed = _quant.arm_quant_compute(
+                progs, self.scope)
         names = {name for name, _, _ in spec.cache_vars}
         claimed = _CACHE_CLAIMS.setdefault(self.scope, set())
         overlap = sorted(claimed & names)
@@ -402,7 +426,8 @@ class GenerationSession:
             self.draft = GenerationSession(
                 spec.draft_spec,
                 scope=self.scope if draft_scope is None else draft_scope,
-                place=place)
+                place=place,
+                arm_quant=False if draft_scope is None else None)
 
     # -- slot bookkeeping ------------------------------------------------
     def free_slots(self):

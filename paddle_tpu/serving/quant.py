@@ -6,15 +6,37 @@ throughput on a v5e MXU and halves weight bytes — the right win for
 almost all f32 accuracy. This pass is the serving-side wiring of that
 probe: ``io.save_inference_model(..., quantize="int8")`` rewrites the
 exported ``params.npz`` so matmul/conv weights are stored as int8 plus
-per-output-channel symmetric scales (a ``quant.json`` sidecar), and
-``io.load_inference_model`` transparently dequantizes at load time, so
-every consumer (InferenceEngine, ServingEngine, the C API bridge, a
-merged single-file model) reads a quantized artifact with no code
-changes. Running the *matmul itself* in int8 on-chip is the next step
-(PROFILE.md keeps the chip-measured line as a TODO); the artifact
-format already carries everything that needs (int8 weights + scales).
+per-output-channel symmetric scales (a ``quant.json`` sidecar). Two
+load modes consume the artifact:
 
-Scope of the pass — weight-only, conservative:
+* **dequantize-at-load** (default, unchanged since PR 2):
+  ``io.load_inference_model`` rebuilds f32 weights in the scope, so
+  every consumer (InferenceEngine, ServingEngine, the C API bridge, a
+  merged single-file model) reads a quantized artifact with no code
+  changes.
+* **int8 COMPUTE** (``serving_quant_compute`` flag): the int8 weights
+  stay int8 on device and the consuming matmul/conv runs int8 x int8
+  accumulated in int32 on the MXU, with the stored per-output-channel
+  scale applied in one fused f32 epilogue (ops/quant_ops.py — the op
+  bodies, the Pallas fused dequant-matmul kernel for the decode hot
+  path, and the numerics contract). :func:`install_quant_compute`
+  arms an artifact load (``ServingEngine`` reads the flag and passes
+  ``quant_compute=True`` to ``load_inference_model``; the f32 copy is
+  never materialized); :func:`arm_quant_compute` arms a live
+  ``GenerationSession`` scope, quantizing in place. Both tag the
+  program (``program._quant_compute``) so the executor keys its
+  compile cache and routes the tagged ops; the per-var scales live in
+  the scope as ``<name>@quant.scale`` sidecar vars.
+
+Compute arming is STRICTER than storage quantization
+(:func:`select_compute_vars` vs :func:`select_quant_vars`): the scaled
+axis must be the contraction *output* in every consumer, so 2-D
+weights only for mul/matmul, ``y_num_col_dims == 1``, no
+``transpose_Y``, 4-D axis-0 filters for conv2d. Storage-quantized vars
+a compute arm can't serve are dequantized at install exactly as the
+default path would — an artifact never half-loads.
+
+Scope of the storage pass — weight-only, conservative:
 
 * only float32 ``Parameter`` tensors consumed exclusively through the
   weight slot of a quantizable op (``mul``/``matmul`` rhs, ``conv2d``
@@ -33,7 +55,9 @@ import os
 import numpy as np
 
 __all__ = ["quantize_array", "dequantize_array", "select_quant_vars",
-           "quantize_model_dir", "load_quant_meta", "maybe_dequantize",
+           "select_compute_vars", "quantize_model_dir", "load_quant_meta",
+           "maybe_dequantize", "install_quant_compute",
+           "arm_quant_compute", "scale_var_name",
            "QUANT_OPS", "DEFAULT_FALLBACK_OPS", "QUANT_META_FILE"]
 
 QUANT_META_FILE = "quant.json"
@@ -186,3 +210,146 @@ def maybe_dequantize(dirname, scope):
             np.asarray(q), info["scales"], info["axis"]))
         done.append(name)
     return done
+
+
+# ---------------------------------------------------------------------
+# int8 COMPUTE arming (ops/quant_ops.py runs the armed ops)
+# ---------------------------------------------------------------------
+
+def scale_var_name(name):
+    """Scope name of the scale sidecar var for weight ``name``."""
+    from ..ops import quant_ops as _qops
+    return _qops.scale_var_name(name)
+
+
+def select_compute_vars(program, fallback_ops=DEFAULT_FALLBACK_OPS):
+    """Subset of :func:`select_quant_vars` the int8 COMPUTE path can
+    serve. Beyond storage safety, every consumer must keep the scaled
+    (output-channel) axis OUT of the contraction: 2-D weights only for
+    mul/matmul, ``y_num_col_dims == 1`` for mul, no ``transpose_Y`` for
+    matmul (it would contract over the scaled axis), 4-D axis-0 filters
+    for conv2d."""
+    targets = select_quant_vars(program, fallback_ops=fallback_ops)
+    if not targets:
+        return {}
+    block = program.global_block()
+    bad = set()
+    for op in block.ops:
+        spec = QUANT_OPS.get(op.type)
+        if spec is None:
+            continue
+        slot = spec[0]
+        for n in op.inputs.get(slot, []):
+            if n not in targets:
+                continue
+            nd = len(block.var(n).shape)
+            if op.type in ("mul", "matmul") and nd != 2:
+                bad.add(n)
+            elif op.type == "mul" and \
+                    op.attrs.get("y_num_col_dims", 1) != 1:
+                bad.add(n)
+            elif op.type == "matmul" and \
+                    op.attrs.get("transpose_Y", False):
+                bad.add(n)
+            elif op.type == "conv2d" and nd != 4:
+                bad.add(n)
+    return {n: a for n, a in targets.items() if n not in bad}
+
+
+def _tag_program(program, vars_, pallas):
+    """Attach the executor-facing compute tag. The tag keys the compile
+    cache (``key`` is hashable and order-stable), so re-arming with the
+    same var set reuses the compiled step."""
+    vars_ = dict(vars_)
+    program._quant_compute = {
+        "vars": vars_,
+        "pallas": bool(pallas),
+        "key": (tuple(sorted(vars_.items())), bool(pallas)),
+    }
+
+
+def install_quant_compute(dirname, program, scope, pallas=None):
+    """Artifact-load arming: keep the int8 weights that the compute
+    path can serve AS int8 in ``scope`` (their scales become
+    ``<name>@quant.scale`` sidecar vars — the f32 copy is never
+    materialized), dequantize the rest exactly like the default load,
+    and tag ``program``. Returns the list of compute-armed names."""
+    meta = load_quant_meta(dirname)
+    if meta is None:
+        return []
+    if pallas is None:
+        from .. import config as _config
+        pallas = bool(_config.get_flag("quant_pallas"))
+    compute = select_compute_vars(program)
+    armed = {}
+    for name, info in meta["vars"].items():
+        q = scope.find_var(name)
+        if q is None:
+            continue
+        axis = compute.get(name)
+        if axis is not None and int(info["axis"]) == axis:
+            scope.set_var(name, np.asarray(q))
+            scope.set_var(scale_var_name(name),
+                          np.asarray(info["scales"], dtype=np.float32))
+            armed[name] = axis
+        else:
+            scope.set_var(name, dequantize_array(
+                np.asarray(q), info["scales"], info["axis"]))
+    if armed:
+        _tag_program(program, armed, pallas)
+    return sorted(armed)
+
+
+def arm_quant_compute(programs, scope, fallback_ops=DEFAULT_FALLBACK_OPS,
+                      pallas=None):
+    """Live-session arming: quantize ``scope``'s weights in place and
+    tag every program in ``programs`` that consumes them. A var is
+    armed only when EVERY program either doesn't reference it or
+    selects it with the same axis — programs share the scope, so a
+    single non-quantizable consumer anywhere keeps the var f32.
+    Idempotent: an already-int8 var with its scale sidecar present is
+    tagged without re-quantizing (re-arming after ``_rebuild`` or for
+    a draft session sharing the target scope). Returns the sorted list
+    of armed names."""
+    programs = [p for p in programs if p is not None]
+    if not programs:
+        return []
+    if pallas is None:
+        from .. import config as _config
+        pallas = bool(_config.get_flag("quant_pallas"))
+    selections = [select_compute_vars(p, fallback_ops=fallback_ops)
+                  for p in programs]
+    referenced = []
+    for p in programs:
+        names = set()
+        for op in p.global_block().ops:
+            for lst in op.inputs.values():
+                names.update(lst)
+        referenced.append(names)
+    candidates = {}
+    for sel in selections:
+        candidates.update(sel)
+    armed = {}
+    for name, axis in candidates.items():
+        if any(name in refs and sel.get(name) != axis
+               for refs, sel in zip(referenced, selections)):
+            continue
+        w = scope.find_var(name)
+        if w is None:
+            continue
+        w = np.asarray(w)
+        sname = scale_var_name(name)
+        if w.dtype == np.int8:
+            if scope.find_var(sname) is None:
+                continue  # foreign int8 without scales: not ours
+        else:
+            q, scales = quantize_array(w, axis)
+            scope.set_var(name, q)
+            scope.set_var(sname, scales)
+        armed[name] = axis
+    if armed:
+        for p, sel in zip(programs, selections):
+            tag = {n: a for n, a in sel.items() if n in armed}
+            if tag:
+                _tag_program(p, tag, pallas)
+    return sorted(armed)

@@ -573,6 +573,11 @@ class TestDefaultOff:
         assert ptpu.config.get_flag("decode_speculate_k") == 0
         assert ptpu.config.get_flag("decode_draft_model") is None
         assert ptpu.config.get_flag("decode_constraint") is None
+        assert ptpu.config.get_flag("serving_quant_compute") is False
+        assert ptpu.config.get_flag("quant_pallas") is False
+        assert ptpu.config.get_flag("generation_kv_dtype") is None
+        assert ptpu.config.get_flag("embedding_wire_dtype") is None
+        assert ptpu.config.get_flag("fused_conv_bn") is False
 
     def test_dispatcher_hot_path_reads_no_flags(self, monkeypatch):
         """Acceptance: with the flags at defaults the dispatcher loop
@@ -616,7 +621,11 @@ class TestDefaultOff:
                                          "telemetry_port",
                                          "flight_dir",
                                          "fleet_", "slo_",
-                                         "decode_"))]
+                                         "decode_",
+                                         "serving_quant",
+                                         "quant_pallas",
+                                         "embedding_wire",
+                                         "fused_conv_bn"))]
             workers = [t for t in threading.enumerate()
                        if t.name.startswith("generation-step-")]
             assert not workers

@@ -124,3 +124,44 @@ class TestViolations:
     def test_unparseable_file_reported(self, tmp_path):
         probs = _scan_src(tmp_path, "def broken(:\n")
         assert len(probs) == 1 and "unparseable" in probs[0]
+
+
+def _scan_markers(tmp_path, ini, test_src):
+    (tmp_path / "paddle_tpu").mkdir(exist_ok=True)
+    (tmp_path / "pytest.ini").write_text(ini)
+    tests = tmp_path / "tests"
+    tests.mkdir(exist_ok=True)
+    (tests / "test_mod.py").write_text(test_src)
+    return check_metrics.check(str(tmp_path))
+
+
+INI = "[pytest]\nmarkers =\n    quant: quantized-compute tests\n"
+
+
+class TestMarkerLint:
+    def test_declared_marker_clean(self, tmp_path):
+        probs = _scan_markers(
+            tmp_path, INI,
+            "import pytest\npytestmark = pytest.mark.quant\n")
+        assert probs == []
+
+    def test_undeclared_marker_flagged(self, tmp_path):
+        probs = _scan_markers(
+            tmp_path, INI,
+            "import pytest\npytestmark = pytest.mark.quantt\n")
+        assert len(probs) == 1
+        assert "not declared in pytest.ini" in probs[0]
+
+    def test_builtin_marks_exempt(self, tmp_path):
+        probs = _scan_markers(
+            tmp_path, INI,
+            "import pytest\n"
+            '@pytest.mark.parametrize("x", [1])\n'
+            "def test_x(x):\n    pass\n")
+        assert probs == []
+
+    def test_repo_markers_all_declared(self):
+        # the real tree scans clean via TestRealTree, but assert the
+        # quant marker specifically landed in pytest.ini
+        declared = check_metrics._declared_markers(REPO)
+        assert "quant" in declared

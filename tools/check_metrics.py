@@ -18,6 +18,11 @@ must register a name that is
   unlabeled there) only explodes at runtime when both import; this
   catches it statically.
 
+The same run covers pytest-marker hygiene: every ``pytest.mark.X``
+used under ``tests/`` must be declared in pytest.ini's ``markers``
+list (an undeclared marker silently selects nothing under
+``-m 'marker'``, so a typo'd suite drops out of CI without failing).
+
 Wired as a tier-1 test (tests/test_metrics_lint.py) and runnable
 standalone:
 
@@ -148,6 +153,65 @@ def scan_file(path, registrations, problems):
             (where, help_text, fn.attr, labels))
 
 
+# marks pytest itself defines — always legal without declaration
+BUILTIN_MARKS = {"parametrize", "skip", "skipif", "xfail",
+                 "usefixtures", "filterwarnings"}
+
+
+def _declared_markers(root):
+    """Marker names from pytest.ini's ``markers =`` block; None when
+    there is no pytest.ini (the synthetic-tree tests)."""
+    path = os.path.join(root, "pytest.ini")
+    if not os.path.exists(path):
+        return None
+    names, in_block = set(), False
+    with open(path) as f:
+        for line in f:
+            stripped = line.strip()
+            if stripped.startswith("markers"):
+                in_block = True
+                continue
+            if in_block:
+                if line[:1] not in (" ", "\t") and stripped:
+                    break  # next ini key
+                if ":" in stripped:
+                    names.add(stripped.split(":", 1)[0].strip())
+    return names
+
+
+def check_markers(root, problems):
+    """Every ``pytest.mark.X`` under tests/ must be a declared or
+    builtin marker."""
+    declared = _declared_markers(root)
+    tests = os.path.join(root, "tests")
+    if declared is None or not os.path.isdir(tests):
+        return
+    for dirpath, _dirnames, filenames in os.walk(tests):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                src = f.read()
+            try:
+                tree = ast.parse(src, filename=path)
+            except SyntaxError:
+                continue  # pytest collection reports these itself
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.value, ast.Attribute) \
+                        and node.value.attr == "mark" \
+                        and isinstance(node.value.value, ast.Name) \
+                        and node.value.value.id == "pytest":
+                    mark = node.attr
+                    if mark not in declared \
+                            and mark not in BUILTIN_MARKS:
+                        problems.append(
+                            "%s:%d: pytest marker %r is not declared "
+                            "in pytest.ini"
+                            % (path, node.lineno, mark))
+
+
 def check(root):
     """Scan ``<root>/paddle_tpu`` (and tools/, which registers
     nothing but must stay clean). Returns a list of problems."""
@@ -178,6 +242,7 @@ def check(root):
                 "metric %r registered with conflicting labelnames "
                 "%s: %s" % (name, sorted(labelsets),
                             "; ".join(w for w, _h, _k, _l in sites)))
+    check_markers(root, problems)
     return problems
 
 

@@ -1,0 +1,249 @@
+"""Quantized-compute probe (ISSUE 19): headless proof of the int8
+serving path, bf16 KV block pools, and the int8 embedding wire.
+
+Prints:
+* int8 serve — an ``int8`` artifact loaded with ``quant_compute``:
+  weights stay int8 in scope (no f32 copy), dense-vs-Pallas outputs
+  bit-identical, output error vs the f32 export;
+* decode — greedy tokens f32 vs int8-armed GenerationSession (top-1
+  agreement) with per-path tokens/sec;
+* bf16 pools — bytes/block f32 vs bf16 and the concurrency a fixed
+  block-pool byte budget buys under each;
+* int8 wire — two-hop a2a lookup max error vs the per-row
+  symmetric-quant bound, plus static bytes/step f32 vs int8 wire;
+* the ``paddle_quant_compute_ops_total`` counter children (one bump
+  per armed op per compiled program — zero steady-state cost).
+
+Run on CPU anywhere: forces an 8-virtual-device host platform.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+
+def probe_int8_serve(tmp):
+    import paddle_tpu as ptpu
+    from paddle_tpu import io, layers
+    from paddle_tpu.serving import quant
+
+    print("== int8 serve (export -> quant_compute load) ==")
+    main, startup = ptpu.Program(), ptpu.Program()
+    with ptpu.program_guard(main, startup):
+        x = layers.data("x", shape=[64])
+        h = layers.fc(x, 128, act="relu")
+        out = layers.fc(h, 10, act="softmax")
+    exe = ptpu.Executor()
+    exe.run(startup)
+    d = os.path.join(tmp, "model_int8")
+    io.save_inference_model(d, ["x"], [out], exe, main_program=main,
+                            quantize="int8")
+    feed = np.random.RandomState(0).randn(32, 64).astype("float32")
+    want, = exe.run(main, feed={"x": feed}, fetch_list=[out])
+    want = np.asarray(want)
+
+    outs = {}
+    for pallas in (False, True):
+        ptpu.config.set_flags(quant_pallas=pallas)
+        try:
+            with ptpu.scope_guard(ptpu.Scope()):
+                e = ptpu.Executor()
+                prog, feeds, fetches = io.load_inference_model(
+                    d, e, quant_compute=True)
+                scope = ptpu.global_scope()
+                int8_vars = [n for n in scope.var_names()
+                             if np.asarray(
+                                 scope.find_var(n)).dtype == np.int8]
+                got, = e.run(prog, feed={feeds[0]: feed},
+                             fetch_list=fetches)
+                outs[pallas] = np.asarray(got)
+        finally:
+            ptpu.config.set_flags(quant_pallas=False)
+    print("int8 vars in scope: %s" % int8_vars)
+    print("scale sidecars: %s"
+          % [quant.scale_var_name(n) for n in int8_vars])
+    print("max |int8 - f32| output err: %.6f"
+          % float(np.abs(outs[False] - want).max()))
+    print("pallas bitwise == dense: %s"
+          % np.array_equal(outs[False], outs[True]))
+
+
+V, MAXLEN = 61, 24
+KW = dict(d_model=32, num_heads=2, d_ff=64, num_layers=2)
+
+
+def _lm_scope(seed=7):
+    import paddle_tpu as ptpu
+    from paddle_tpu import layers
+    from paddle_tpu.models.transformer import transformer_lm
+
+    with ptpu.unique_name.guard():
+        main, startup = ptpu.Program(), ptpu.Program()
+        with ptpu.program_guard(main, startup):
+            toks = layers.data("toks", shape=[1, MAXLEN], dtype="int64",
+                               append_batch_size=False)
+            lbls = layers.data("lbls", shape=[1, MAXLEN], dtype="int64",
+                               append_batch_size=False)
+            transformer_lm(toks, lbls, vocab_size=V, is_test=True, **KW)
+    exe = ptpu.Executor()
+    scope = ptpu.Scope()
+    with ptpu.scope_guard(scope):
+        exe.run(startup)
+    rs = np.random.RandomState(seed)
+    for n in sorted(scope.var_names()):
+        cur = np.asarray(scope.find_var(n))
+        scope.set_var(n, rs.standard_normal(cur.shape)
+                      .astype(cur.dtype))
+    return scope
+
+
+def _decode(quant_compute=False, kv_dtype=None, steps=16):
+    import paddle_tpu as ptpu
+    from paddle_tpu.models.transformer import transformer_lm_session
+    from paddle_tpu.serving.generation import GenerationSession
+
+    ptpu.config.set_flags(serving_quant_compute=quant_compute,
+                          generation_kv_dtype=kv_dtype)
+    try:
+        scope = _lm_scope()
+        spec = transformer_lm_session(V, max_len=MAXLEN, slots=4,
+                                      cache_len=MAXLEN,
+                                      prompt_buckets=(8,), paged=True,
+                                      block_size=4, **KW)
+        sess = GenerationSession(spec, scope=scope)
+        rs = np.random.RandomState(3)
+        toks = [[int(t) for t in sess.generate(
+                    list(rs.randint(2, V, 5)), max_new_tokens=8,
+                    eos_id=-1)] for _ in range(3)]
+        for _ in range(4):
+            sess.admit(list(rs.randint(2, V, 5)))
+        sess.step()  # warm
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            sess.step()
+        dt = time.perf_counter() - t0
+        stats = sess.pool_stats()
+        sess.close()
+        return toks, 4 * steps / dt, stats
+    finally:
+        ptpu.config.set_flags(serving_quant_compute=False,
+                              generation_kv_dtype=None)
+
+
+def probe_decode():
+    print("== decode: f32 vs int8-armed session ==")
+    t32, tps32, _ = _decode()
+    t8, tps8, _ = _decode(quant_compute=True)
+    flat32 = [t for seq in t32 for t in seq]
+    flat8 = [t for seq in t8 for t in seq]
+    agree = float(np.mean([a == b for a, b in zip(flat32, flat8)]))
+    print("greedy top-1 agreement: %.3f (%d tokens)"
+          % (agree, len(flat32)))
+    print("decode tokens/sec: f32 %.1f | int8 %.1f" % (tps32, tps8))
+
+
+def probe_bf16_pools():
+    print("== bf16 KV block pools ==")
+    _, _, s32 = _decode()
+    tbf, _, sbf = _decode(kv_dtype="bfloat16")
+    b32, bbf = s32["bytes_per_block"], sbf["bytes_per_block"]
+    print("bytes/block: f32 %d | bf16 %d (%.2fx)"
+          % (b32, bbf, b32 / bbf))
+    budget = 64 * b32  # a fixed pool budget in bytes
+    print("sequences a %d-byte pool budget holds (cache_len %d, "
+          "block %d): f32 %d | bf16 %d"
+          % (budget, MAXLEN, s32["block_size"],
+             budget // b32 // (MAXLEN // s32["block_size"]),
+             budget // bbf // (MAXLEN // sbf["block_size"])))
+
+
+def probe_int8_wire():
+    import paddle_tpu as ptpu
+    from paddle_tpu import embeddings, layers, parallel
+    from paddle_tpu.embeddings.sharded import a2a_step_bytes
+
+    print("== int8 embedding wire ==")
+    vocab, dim, batch, slots, shards = 100, 16, 16, 5, 4
+    rs = np.random.RandomState(4)
+    logical = rs.randn(embeddings.padded_vocab(vocab),
+                       dim).astype("float32")
+    ids = rs.randint(0, vocab, (batch, slots)).astype("int64")
+
+    def run(wire):
+        ptpu.config.set_flags(embedding_shard_rows=True,
+                              embedding_a2a=True,
+                              embedding_wire_dtype=wire)
+        try:
+            with ptpu.unique_name.guard():
+                main, startup = ptpu.Program(), ptpu.Program()
+                with ptpu.program_guard(main, startup):
+                    idv = layers.data("ids", shape=[slots],
+                                      dtype="int64")
+                    out = layers.embedding(
+                        idv, size=[vocab, dim], param_attr="table",
+                        is_distributed=True)
+            exe = ptpu.Executor(
+                strategy=parallel.DataParallel(n_devices=shards))
+            with ptpu.scope_guard(ptpu.Scope()):
+                exe.run(startup)
+                ptpu.global_scope().set_var(
+                    "table", embeddings.to_shard_major(logical, shards))
+                return np.asarray(exe.run(main, feed={"ids": ids},
+                                          fetch_list=[out])[0])
+        finally:
+            ptpu.config.set_flags(embedding_shard_rows=False,
+                                  embedding_a2a=False,
+                                  embedding_wire_dtype=None)
+
+    ref = logical[ids.reshape(-1)].reshape(batch, slots, dim)
+    got = run("int8")
+    bound = float((np.amax(np.abs(ref), axis=-1) / 127.0 / 2.0).max())
+    print("lookup max |err|: %.6f (per-row bound %.6f)"
+          % (float(np.abs(got - ref).max()), bound))
+    total = batch * slots
+    ids_b, rows_b = a2a_step_bytes(total, dim, shards, itemsize=4)
+    i8_ids, i8_rows = a2a_step_bytes(total, dim, shards, itemsize=1)
+    i8_rows += shards * total * 4  # f32 per-row scales ride along
+    print("a2a bytes/step: f32 wire %d | int8 wire %d (%.2fx)"
+          % (ids_b + rows_b, i8_ids + i8_rows,
+             (ids_b + rows_b) / float(i8_ids + i8_rows)))
+
+
+def dump_quant_counters():
+    from paddle_tpu.observability import metrics
+
+    print("== paddle_quant_* counters ==")
+    for name, _kind, _help, _bk, children in metrics.REGISTRY.snapshot():
+        if not name.startswith("paddle_quant"):
+            continue
+        for labels, value in children:
+            print("%s%s = %d" % (name, labels, value))
+
+
+def main():
+    print("devices=%d" % len(jax.devices()))
+    with tempfile.TemporaryDirectory() as tmp:
+        probe_int8_serve(tmp)
+    probe_decode()
+    probe_bf16_pools()
+    probe_int8_wire()
+    dump_quant_counters()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()
