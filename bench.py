@@ -1535,6 +1535,208 @@ def bench_fleet(on_accel):
     }]
 
 
+def bench_model_paging(on_accel):
+    """Multi-model paging costs (ISSUE 20), tripwired:
+
+    * ``model_page_in_ms`` — wall clock of the FIRST request for a
+      not-yet-resident catalog model on a warm fleet: the router
+      demand-pages the model (manifest-verified staged load through
+      the swap gates) onto a member and serves the full decode. This
+      is the capacity move that replaces a cold spawn — compare
+      ``scale_up_to_first_token_ms``, which pays a whole process
+      launch (its CPU noise floor alone is 1500 ms); a page-in only
+      pays a host-snapshot load + activation swap.
+    * ``model_residency_hit_rate`` — fraction of mixed two-tenant
+      requests whose model was already resident on a live member at
+      placement, across steady traffic on a byte-budgeted fleet where
+      paging model B in FORCED an LRU eviction of model A (the bench
+      raises if the budget never evicted — a hit rate measured
+      without residency pressure is vacuous). Higher is better; the
+      single cold page-in is the only expected miss.
+    * ``paging_client_errors`` — client-visible errors across all of
+      the above, which must be 0 (the bench raises otherwise, and
+      also raises on any token diverging from the per-model oracle:
+      two models sharing members must never mix outputs)."""
+    import tempfile
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    import fleet_worker_child as child
+    from paddle_tpu.observability import metrics as obs_metrics
+    from paddle_tpu.serving import model_paging as mp
+    from paddle_tpu.serving.fleet import FleetRouter
+
+    suffix = "" if on_accel else "_cpu_smoke"
+    tmp = tempfile.mkdtemp(prefix="bench_model_paging_")
+    cache_dir = os.path.join(tmp, "compile_cache")
+    max_new, n_steady = 8, 12
+
+    def csum(name, **labels):
+        total = 0.0
+        for s in obs_metrics.REGISTRY.dump().get(name, {}).get(
+                "samples", ()):
+            if all(s["labels"].get(k) == v for k, v in
+                   labels.items()):
+                total += s["value"]
+        return total
+
+    # two genuinely different models sharing one program shape —
+    # distinct seeds, not a scaled copy (greedy attractors make a
+    # scaled copy decode identically, faking bit-identity)
+    scope_a = child.build_scope(seed=7)
+    scope_b = child.build_scope(seed=11)
+    path_a = os.path.join(tmp, "A.npz")
+    path_b = os.path.join(tmp, "B.npz")
+    np.savez(path_a, **child.model_params(scope_a))
+    np.savez(path_b, **child.model_params(scope_b))
+    mp.write_weights_manifest(path_a)
+    mp.write_weights_manifest(path_b)
+    nbytes = os.path.getsize(path_a)
+
+    cold_prompt = [child.BOS, 5, 9]
+    prompts_a = child.chaos_prompts(n_steady, seed=3)
+    prompts_b = child.chaos_prompts(n_steady, seed=23)
+
+    def oracle_tokens(scope, prompts):
+        sched = child.make_scheduler(scope)
+        futs = [sched.submit(p, max_new_tokens=max_new, eos_id=-1)
+                for p in prompts]
+        outs = [[int(t) for t in f.result(timeout=300)]
+                for f in futs]
+        sched.close()
+        return outs
+
+    base_a = oracle_tokens(scope_a, prompts_a)
+    base_b = oracle_tokens(scope_b, [cold_prompt] + prompts_b)
+    base_b_cold, base_b = base_b[0], base_b[1:]
+
+    router = FleetRouter(
+        heartbeat_timeout_ms=700, replay_attempts=4,
+        models={"A": {"params_path": path_a, "tag": "A@v0",
+                      "bytes": nbytes, "tenants": ("acme",)},
+                "B": {"params_path": path_b, "tag": "B@v0",
+                      "bytes": nbytes, "tenants": ("bravo",)}},
+        # room for ONE model per member: paging B in MUST evict A
+        resident_bytes=int(nbytes * 1.5),
+        page_timeout_ms=120000.0)
+    procs, errors = [], []
+    page0 = csum("paddle_fleet_model_page_ins_total", outcome="ok")
+    evict0 = csum("paddle_fleet_model_evictions_total")
+    hits0 = csum("paddle_fleet_model_residency_hits_total")
+    miss0 = csum("paddle_fleet_model_residency_misses_total")
+    try:
+        for mid in ("m0", "m1"):
+            proc = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(
+                     os.path.dirname(os.path.abspath(__file__)),
+                     "tests", "fleet_worker_child.py"),
+                 "--router", "%s:%d" % router.addr, "--member", mid,
+                 "--heartbeat-ms", "150",
+                 "--compile-cache", cache_dir,
+                 "--model", "A", "--version", "A@v0"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True)
+            line = proc.stdout.readline().strip()
+            if not line.startswith("READY"):
+                proc.kill()
+                raise RuntimeError("fleet worker failed: %r" % line)
+            procs.append(proc)
+        router.wait_members(2, timeout=300)
+
+        # cold page-in: the first model-B request on a warm fleet
+        t0 = time.perf_counter()
+        out = router.submit(cold_prompt, max_new_tokens=max_new,
+                            eos_id=-1, tenant="bravo",
+                            meta=True).result(timeout=600)
+        page_in_ms = (time.perf_counter() - t0) * 1e3
+        if out["tokens"].tolist() != base_b_cold:
+            raise RuntimeError("cold page-in diverged from the "
+                               "model-B oracle")
+        if csum("paddle_fleet_model_page_ins_total",
+                outcome="ok") - page0 != 1.0:
+            raise RuntimeError("the cold request did not demand-page")
+
+        # steady mixed traffic: residency affinity must route every
+        # request to a member already holding its model — zero
+        # further page-ins, bit-identical to each model's oracle
+        futs = []
+        for pa, pb in zip(prompts_a, prompts_b):
+            futs.append(router.submit(pa, max_new_tokens=max_new,
+                                      eos_id=-1, tenant="acme"))
+            futs.append(router.submit(pb, max_new_tokens=max_new,
+                                      eos_id=-1, tenant="bravo"))
+        got = []
+        for f in futs:
+            try:
+                got.append([int(t) for t in f.result(timeout=300)])
+            except Exception as exc:  # noqa: BLE001
+                errors.append(repr(exc))
+                got.append(None)
+        want = [t for ab in zip(base_a, base_b) for t in ab]
+        mism = [i for i, (g, w) in enumerate(zip(got, want))
+                if g is not None and g != w]
+        if errors or mism:
+            raise RuntimeError(
+                "mixed two-model traffic broke the zero-error/"
+                "bit-identity contract: errors=%r diverged=%r"
+                % (errors[:3], mism[:5]))
+        hits = csum("paddle_fleet_model_residency_hits_total") - hits0
+        misses = csum(
+            "paddle_fleet_model_residency_misses_total") - miss0
+        hit_rate = hits / max(1.0, hits + misses)
+        if csum("paddle_fleet_model_page_ins_total",
+                outcome="ok") - page0 != 1.0:
+            raise RuntimeError("affinity re-paged during steady "
+                               "mixed traffic")
+        if csum("paddle_fleet_model_evictions_total") - evict0 < 1.0:
+            raise RuntimeError(
+                "the byte budget never forced an eviction — the "
+                "hit rate ran without residency pressure")
+    finally:
+        router.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait()
+
+    return [{
+        "metric": "model_page_in_ms" + suffix,
+        "value": round(page_in_ms, 1),
+        "unit": "ms for the FIRST request of a not-yet-resident "
+                "catalog model on a warm fleet (manifest-verified "
+                "demand page-in + activation swap + full decode) — "
+                "the capacity move that replaces a cold spawn: "
+                "compare scale_up_to_first_token_ms, whose CPU "
+                "noise floor alone is 1500 ms",
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        # host-snapshot load + swap, no process launch: only a
+        # paging-path blowup should trip, not decode jitter
+        "regression_floor": 500.0,
+    }, {
+        "metric": "model_residency_hit_rate" + suffix,
+        "value": round(hit_rate, 3),
+        "unit": "fraction of mixed two-tenant requests whose model "
+                "was already resident on a live member at placement "
+                "(byte budget sized to force an eviction; the one "
+                "cold page-in is the only expected miss)",
+        "vs_baseline": 1.0,
+        "hits": int(hits),
+        "misses": int(misses),
+    }, {
+        "metric": "paging_client_errors" + suffix,
+        "value": len(errors),
+        "unit": "client-visible errors across mixed two-tenant "
+                "traffic on a byte-budgeted two-model fleet (MUST "
+                "be 0 — the bench raises otherwise)",
+        "higher_is_better": False,
+        "vs_baseline": 1.0,
+        "steady_requests": len(got),
+        "must_be_zero": True,
+    }]
+
+
 def bench_recsys(on_accel):
     """Recsys (wide&deep) training with row-sharded DistEmbedding
     tables (ISSUE 14): real sparse id batches cross the PR-4 packed
@@ -1911,6 +2113,8 @@ def main():
              lambda: bench_tracing_overhead(on_accel)),
             ("fleet_p99_under_kill_ms",
              lambda: bench_fleet(on_accel)),
+            ("model_page_in_ms",
+             lambda: bench_model_paging(on_accel)),
             ("recsys_examples_per_sec",
              lambda: bench_recsys(on_accel)),
             ("slo_detection_latency_ms",

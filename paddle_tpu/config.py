@@ -330,6 +330,33 @@ autoscale_spawn_failures: the spawn-failure budget — after this many
   launch path degrades to a fixed-size fleet instead of a crash loop.
   Read only at FleetAutoscaler construction.
 
+fleet_models: the multi-model catalog, or None (default) — a dict of
+  ``model id -> {"params_path"/"model_dir": ..., "tag": ...,
+  "bytes": N, "tenants": (...)}`` naming every model the fleet may
+  page (serving/model_paging.py). With a catalog armed the router
+  routes each tenant to its model's resident members
+  (residency-affinity placement), demand-pages non-resident models in
+  through the PR-7 swap gates, and applies LRU eviction pressure
+  against ``member_resident_bytes``. None: no catalog, no residency
+  state, no paging verbs on any frame — routing and envelopes stay
+  byte-identical. Read only at router construction.
+
+member_resident_bytes: per-member resident-set byte budget for the
+  multi-model fleet — when the catalog-accounted bytes of a member's
+  resident models exceed it after a page-in, the router evicts LRU
+  resident models from that member (never a model with in-flight
+  requests — the BlockPool refcount discipline applied to whole
+  weight sets). 0 (default): no eviction pressure. Read only at
+  router construction, and only when ``fleet_models`` armed a
+  catalog.
+
+model_page_timeout_ms: the bound on one demand page-in (staged load
+  -> canary -> flip on the target member) — a page-in that has not
+  completed within it is treated as wedged and charged to the
+  autoscaler's spawn-failure budget, exactly like a wedged spawn.
+  Read only at router construction, and only when ``fleet_models``
+  armed a catalog.
+
 embedding_shard_rows: if True, DistEmbedding tables created by
   ``layers.embedding(..., is_distributed=True)`` are row-sharded over
   the mesh data axis by ``row_id % num_shards`` (mod-interleaved
@@ -498,6 +525,14 @@ _flags = {
     "autoscale_idle_ms": 10000.0,
     "autoscale_spawn_timeout_ms": 30000.0,
     "autoscale_spawn_failures": 3,
+    # multi-model fleet paging (serving/model_paging.py + fleet.py;
+    # read only at router construction — and the byte budget / page
+    # timeout only when a catalog is actually armed. None/0 defaults
+    # build no catalog, no residency state, and keep every envelope
+    # and heartbeat frame byte-identical)
+    "fleet_models": None,
+    "member_resident_bytes": 0,
+    "model_page_timeout_ms": 30000.0,
     # sharded embedding tables (embeddings/sharded.py; read only when a
     # program registered a DistEmbedding — defaults construct none of
     # the subsystem and plain programs never read these)

@@ -93,7 +93,10 @@ def labeled_source(histogram=DEFAULT_HISTOGRAM,
     one tracker per tenant, each reading its own
     ``paddle_fleet_tenant_request_ms{tenant=...}`` child and the
     matching shed/deadline children, so a bursting tenant burns its
-    OWN budget while the victim tenant's verdict stays green."""
+    OWN budget while the victim tenant's verdict stays green. The
+    per-model SLO verdicts of a multi-model fleet (PR 20) slice the
+    same way — one tracker per catalog model over
+    ``paddle_fleet_model_request_ms{model=...}``."""
     reg = registry if registry is not None else _metrics.REGISTRY
     bad_counters = tuple(bad_counters)
     label = str(label)

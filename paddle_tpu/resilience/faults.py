@@ -124,6 +124,24 @@ site                          where / what
                               verdict, so the request resolves with the
                               typed :class:`ConstraintDeadEnd` client
                               error (never a hang, never a replay)
+``model_page_in_fail``        EngineWorker page_in handler, before any
+                              weight lands — ``index`` is the model id;
+                              a raising spec IS a torn/refused artifact:
+                              the member keeps its resident set and the
+                              router charges the page-in to the
+                              autoscaler's spawn-failure budget
+``model_page_in_slow``        EngineWorker page_in handler — arm with
+                              ``action="callback"`` sleeping past
+                              ``model_page_timeout_ms`` (``index`` =
+                              model id): the router times the page-in
+                              out, charges the budget, and retries on a
+                              peer
+``model_evict_race``          FleetRouter eviction pressure, between
+                              victim selection and the page_out send —
+                              ``index`` is the victim model id; a
+                              raising spec aborts the eviction round
+                              (the victim stays resident), the window a
+                              late pin would otherwise race
 ============================  =============================================
 
 Actions: ``"raise"`` (raise ``exc``, default :class:`InjectedFault`),

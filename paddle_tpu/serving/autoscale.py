@@ -70,9 +70,11 @@ _SCALE_DOWNS = _metrics.REGISTRY.counter(
     "Capacity-down actions (idle member drained and retired)")
 _SPAWN_FAILURES = _metrics.REGISTRY.counter(
     "paddle_autoscale_spawn_failures_total",
-    "Spawns charged to the failure budget, by cause (error: the spawn "
-    "callable raised; exit: the process died before REG; timeout: no "
-    "REG within autoscale_spawn_timeout_ms)", labelnames=("cause",))
+    "Provisioning failures charged to the budget, by cause (error: "
+    "the spawn callable raised; exit: the process died before REG; "
+    "timeout: no REG within autoscale_spawn_timeout_ms; page_in: a "
+    "model page-in failed or wedged — serving/model_paging.py)",
+    labelnames=("cause",))
 _SPAWN_JOIN_MS = _metrics.REGISTRY.histogram(
     "paddle_autoscale_spawn_to_join_ms",
     "Launch-to-REG latency of autoscaler-spawned members (the "
@@ -300,6 +302,31 @@ class FleetAutoscaler:
             _log.structured("autoscale_halted", scaler=self.label,
                             failures=self.spawn_failures)
             _flight.RECORDER.trigger_async("autoscale_spawn_budget")
+
+    def charge_failure(self, cause):
+        """Charge one provisioning failure that happened OUTSIDE the
+        spawn path — a wedged or failed model page-in
+        (serving/model_paging.py) spends the same budget a failed
+        spawn does: both are capacity actions, and a persistently
+        broken one must halt the control loop (flight bundle, halted
+        flag) instead of thrashing the fleet."""
+        with self._lock:
+            self.spawn_failures += 1
+            _SPAWN_FAILURES.labels(cause=str(cause)).inc()
+            _log.structured("autoscale_spawn_charged",
+                            scaler=self.label, member=None,
+                            cause=str(cause),
+                            failures=self.spawn_failures,
+                            budget=self.spawn_failure_budget)
+            if not self.halted \
+                    and self.spawn_failures >= \
+                    self.spawn_failure_budget:
+                self.halted = True
+                _log.structured("autoscale_halted",
+                                scaler=self.label,
+                                failures=self.spawn_failures)
+                _flight.RECORDER.trigger_async(
+                    "autoscale_spawn_budget")
 
     def request_scale_up(self, reason="manual", now=None):
         """Spawn one member immediately (bench / operator path):

@@ -20,6 +20,11 @@ This module makes deploys first-class:
   digest-verified, signature-checked, and canary-executed before the
   atomic flip, and a post-flip error burst auto-rolls back to the
   prior weights.
+* :func:`write_weights_manifest` / :func:`verify_weights_manifest`
+  (re-exported from serving/model_paging.py): the sha256 + per-var
+  shape/dtype sidecar beside an ``.npz`` weights artifact that makes
+  a fleet page-in a *manifest-verified* staged load — a truncated or
+  switched artifact is refused before any weight touches a scope.
 
 Fault sites (resilience/faults.py): ``swap_bad_artifact`` (fires in
 swap validation), ``swap_canary_fail`` (fires before the canary run);
@@ -53,9 +58,13 @@ from ..observability import metrics as _metrics
 from ..utils import log as _log
 from ..utils.merge_model import COMPILED_DIR as _COMPILED_DIR
 
+from .model_paging import (verify_weights_manifest,
+                           write_weights_manifest)
+
 __all__ = ["SwapRejectedError", "export_compiled_buckets",
            "load_compiled_index", "read_compiled_blob",
-           "synth_bucket_feed"]
+           "synth_bucket_feed", "write_weights_manifest",
+           "verify_weights_manifest"]
 
 AOT_LOADS = _metrics.REGISTRY.counter(
     "paddle_deploy_aot_loads_total",

@@ -182,13 +182,16 @@ class ServingEngine:
         self._artifact_dir = artifact_dir
         try:
             # construction-time flag read: int8 artifacts serve their
-            # weights AS int8 through the MXU (serving/quant.py)
-            quant_compute = bool(
+            # weights AS int8 through the MXU (serving/quant.py).
+            # Remembered for swap_weights: a staged push must load
+            # through the SAME quant path, or an f32 push can never
+            # match an int8-armed engine's dtype signature.
+            self._quant_compute = bool(
                 _config.get_flag("serving_quant_compute"))
             (self.program, self.feed_names,
              self.fetch_names) = _io.load_inference_model(
                  artifact_dir, exe0, scope=scope0,
-                 quant_compute=quant_compute)
+                 quant_compute=self._quant_compute)
             # the exact variable set an artifact loads — the
             # shape/dtype signature swap_weights validates a new push
             # against
@@ -920,7 +923,23 @@ class ServingEngine:
                 # member — no separate verify pass) and raises the
                 # reason into this block
                 program2, feeds2, fetches2 = _io.load_inference_model(
-                    model_dir, Executor(), scope=stage_scope)
+                    model_dir, Executor(), scope=stage_scope,
+                    quant_compute=self._quant_compute)
+                if self._quant_compute and \
+                        getattr(self.program, "_quant_compute",
+                                None) and \
+                        not getattr(program2, "_quant_compute",
+                                    None):
+                    # the engine serves int8-armed weights but the
+                    # push is a plain f32 artifact (no quant.json —
+                    # install_quant_compute was a no-op): quantize
+                    # the staged scope in place so the push gains the
+                    # int8 vars + @quant.scale sidecars the live
+                    # signature check expects. Without this, ANY f32
+                    # push to an int8-armed engine trips the dtype
+                    # gate — and so does the rollback that follows.
+                    from . import quant as _quant
+                    _quant.arm_quant_compute([program2], stage_scope)
                 if list(feeds2) != list(self.feed_names) or \
                         list(fetches2) != list(self.fetch_names):
                     raise ValueError(

@@ -82,6 +82,27 @@ and retires them idle (``retire_member``), bounded by
 ``fleet_members_min``/``fleet_members_max``. The router never
 constructs one.
 
+Multi-model paging (serving/model_paging.py, PR 20): with a model
+catalog armed (the ``fleet_models`` flag / the ``models=`` ctor arg)
+weights become a *paged* resource — each member advertises its
+resident model set on REG and every heartbeat (generation-fenced
+like membership itself), placement gains a residency-affinity term
+(a tenant's request routes to a member already holding its model), a
+request for a nowhere-resident model demand-pages it onto the
+least-loaded member through the worker's swap gates (``page_in``:
+manifest-verified staged load -> flip, bounded by
+``model_page_timeout_ms`` and charged to the autoscaler's
+spawn-failure budget on wedge), LRU eviction pressure holds each
+member's resident-set bytes under ``member_resident_bytes`` (never
+evicting a model with in-flight requests — the BlockPool refcount
+discipline applied to whole weight sets), and the replay journal
+gains the model id as its third fence beside weights version and
+decode policy: a journal can never splice onto the wrong model, and
+a journal whose model was paged out re-pages it on the target member
+BEFORE re-drive — a SIGKILL'd member's in-flight generations land
+bit-identically on a peer that didn't hold the model when the
+request started.
+
 Fault sites (resilience/faults.py): ``fleet_member_kill`` (worker
 side, indexed by streamed-token count — ``action="kill"`` SIGKILLs
 the worker mid-generation), ``fleet_network_partition`` (router side
@@ -90,14 +111,18 @@ loop swallows beats under the same site, so one arm simulates both
 directions of a partition), ``fleet_slow_member`` (worker side before
 serving, indexed by member id — arm a callback sleeping past the
 router's call timeout), plus the autoscaler's ``fleet_spawn_fail`` /
-``fleet_spawn_slow`` (serving/autoscale.py).
+``fleet_spawn_slow`` (serving/autoscale.py) and the paging sites
+``model_page_in_fail`` / ``model_page_in_slow`` /
+``model_evict_race`` (serving/model_paging.py).
 
 Default flags construct NONE of this: no router, no worker, no
-sockets, no threads, no autoscaler, no tenant table.
-``fleet_heartbeat_ms`` / ``fleet_members_min`` /
-``fleet_canary_fraction`` / ``fleet_tenants`` are read only inside
-these constructors — single-process serving behavior and hot-path
-flag-check counts are byte-identical with the fleet unused.
+sockets, no threads, no autoscaler, no tenant table, no model
+catalog. ``fleet_heartbeat_ms`` / ``fleet_members_min`` /
+``fleet_canary_fraction`` / ``fleet_tenants`` / ``fleet_models`` are
+read only inside these constructors (and ``member_resident_bytes`` /
+``model_page_timeout_ms`` only when a catalog is actually armed) —
+single-process serving behavior and hot-path flag-check counts are
+byte-identical with the fleet unused.
 """
 
 import inspect
@@ -120,6 +145,7 @@ from ..observability import request_trace as _rtrace
 from ..observability import slo as _slo
 from ..resilience import faults as _faults
 from ..utils import log as _log
+from . import model_paging as _paging
 from . import resilience as _sres
 from . import wire as _wire
 from .batcher import _WAIT_ALPHA, TenantQuotaError, _resolve
@@ -145,9 +171,11 @@ _FENCED = _metrics.REGISTRY.counter(
     "by the time they landed (generation fencing, serving tier)")
 _JOURNAL_RESETS = _metrics.REGISTRY.counter(
     "paddle_fleet_journal_resets_total",
-    "Replay journals discarded because the only willing peer served "
-    "a different weights version (the generation restarts from the "
-    "prompt — a mixed-version response is never served)")
+    "Replay journals discarded at a fence, by reason (version: the "
+    "only willing peer served different weights; policy: a different "
+    "decode-policy fingerprint; model: a different model id — the "
+    "generation restarts from the prompt; a spliced response is "
+    "never served)", labelnames=("reason",))
 _DEPLOYS = _metrics.REGISTRY.counter(
     "paddle_fleet_deploys_total",
     "Rolling deploys by outcome", labelnames=("outcome",))
@@ -214,10 +242,20 @@ class _VersionRetry(Exception):
     Not a member failure — no breaker charge, no replay burned."""
 
 
+class _ModelRetry(Exception):
+    """The member refused the hop because the request's model is not
+    resident there (paged out between placement and dispatch, or the
+    router's residency view was stale). Not a member failure — no
+    breaker charge, no replay burned; the serve loop corrects its
+    residency view and re-drives through ``_ensure_resident``, which
+    re-pages the model first."""
+
+
 class _Member:
     __slots__ = ("id", "addr", "state", "joined_gen", "deadline",
                  "version", "policy", "inflight", "served", "failures",
-                 "breaker", "conns", "label", "index")
+                 "breaker", "conns", "label", "index", "residency",
+                 "active_model", "paging")
 
     def __init__(self, mid, addr, gen, label, index):
         self.id = mid
@@ -234,6 +272,11 @@ class _Member:
         self.conns = set()    # open per-request data connections
         self.label = label    # "f<router>:<member>" — gauge namespace
         self.index = index    # dense join order (breaker index)
+        # multi-model residency (PR 20): what this member advertises
+        # as paged in, generation-fenced like membership itself
+        self.residency = _paging.ModelResidencySet()
+        self.active_model = None  # model id the member last acked
+        self.paging = False       # a page-in is in flight on it
 
 
 class _Tenant:
@@ -257,10 +300,11 @@ class _FleetRequest:
                  "failed_on", "canary", "tokens_version",
                  "tokens_policy", "seed", "version",
                  "version_start", "member", "fail_t", "t_submit",
-                 "tenant", "tenant_entry")
+                 "tenant", "tenant_entry", "model", "tokens_model",
+                 "model_counted", "model_retries")
 
     def __init__(self, prompt, max_new, eos_id, deadline, meta,
-                 seed=0, tenant=None):
+                 seed=0, tenant=None, model=None):
         self.prompt = [int(t) for t in prompt]
         self.tokens = []          # the replay journal's generated half
         self.max_new = max_new
@@ -286,6 +330,13 @@ class _FleetRequest:
         # re-sends it for free)
         self.tenant = None if tenant is None else str(tenant)
         self.tenant_entry = None  # admission row to release, or None
+        # the model this request targets (catalog-armed routers only):
+        # carried on every hop's envelope like the seed; the third
+        # journal fence beside weights version and decode policy
+        self.model = None if model is None else str(model)
+        self.tokens_model = None  # model id that produced the journal
+        self.model_counted = False  # residency hit/miss counted once
+        self.model_retries = 0    # bounded model-residency re-drives
 
     def journal(self):
         return self.prompt + self.tokens
@@ -319,6 +370,13 @@ class FleetRouter:
     ``member_inflight_limit`` (> 0) caps per-member in-flight so
     placement becomes a contended resource (requests queue at the
     router — what priority tiers and the placement-wait EWMA act on).
+    ``models`` (default: the ``fleet_models`` flag) arms the model
+    catalog — ``{model id: {"params_path"/"model_dir", "tag",
+    "bytes", "tenants"}}`` — and with it residency-affinity routing,
+    demand paging, and eviction pressure; ``resident_bytes`` /
+    ``page_timeout_ms`` (defaults: the ``member_resident_bytes`` /
+    ``model_page_timeout_ms`` flags, read only when a catalog is
+    armed) bound a member's resident set and one page-in.
     """
 
     def __init__(self, host="127.0.0.1", port=0,
@@ -328,7 +386,9 @@ class FleetRouter:
                  placement_timeout=30.0, canary_fraction=None,
                  members_min=None, metrics_interval_ms=None,
                  slo_target_p99_ms=None, slo_windows=None,
-                 tenants=None, member_inflight_limit=0):
+                 tenants=None, member_inflight_limit=0,
+                 models=None, resident_bytes=None,
+                 page_timeout_ms=None):
         self._rid = next(_ROUTER_SEQ)
         if heartbeat_timeout_ms is None:
             heartbeat_timeout_ms = \
@@ -376,6 +436,28 @@ class FleetRouter:
                     self._tenants[tid] = _Tenant(
                         tid, quota, priority,
                         "f%d:%s" % (self._rid, tid))
+        # the model catalog: None (default) = single-model fleet —
+        # no catalog, no residency routing, no paging verbs, every
+        # envelope/heartbeat frame byte-identical. The byte budget
+        # and page timeout are read ONLY when a catalog is armed, so
+        # default construction reads exactly one extra flag.
+        if models is None:
+            models = _config.get_flag("fleet_models")
+        self._catalog = None
+        self._model_slos = {}
+        self._paging = {}          # model id -> in-flight page-in Event
+        self.resident_bytes = 0
+        self.page_timeout = 0.0
+        if models:
+            self._catalog = _paging.ModelCatalog.from_value(models)
+            if resident_bytes is None:
+                resident_bytes = _config.get_flag(
+                    "member_resident_bytes")
+            self.resident_bytes = int(resident_bytes or 0)
+            if page_timeout_ms is None:
+                page_timeout_ms = _config.get_flag(
+                    "model_page_timeout_ms")
+            self.page_timeout = float(page_timeout_ms or 0.0) / 1e3
         # per-member in-flight cap: 0 (default) = least-loaded only,
         # members absorb any depth. >0 makes placement a real resource
         # (requests queue AT THE ROUTER when every member is full),
@@ -425,6 +507,22 @@ class FleetRouter:
                                 "paddle_serving_tenant_shed_total",
                                 "paddle_fleet_tenant_deadline_total"),
                             label="tenant", value=entry.label))
+            if self._catalog is not None:
+                # one tracker per catalog model (same discipline as
+                # the per-tenant slice): /debug/slo answers "which
+                # MODEL's p99 is blown" — a paged-out model's churn
+                # burns its own budget, its co-resident's stays green
+                for model_id in self._catalog.ids():
+                    mlabel = "f%d:%s" % (self._rid, model_id)
+                    self._model_slos[model_id] = _slo.SLOTracker(
+                        label=mlabel,
+                        target_p99_ms=float(slo_target_p99_ms),
+                        windows=slo_windows,
+                        source=_slo.labeled_source(
+                            histogram="paddle_fleet_model_request_ms",
+                            bad_counters=(
+                                "paddle_fleet_model_deadline_total",),
+                            label="model", value=mlabel))
         self._members = {}          # member id -> _Member
         self._generation = 0
         self._member_seq = itertools.count()
@@ -561,6 +659,9 @@ class FleetRouter:
                     "breaker": None if m.breaker is None
                     else m.breaker.state,
                 }
+                if m.residency.models or m.active_model is not None:
+                    members[m.id]["residency"] = m.residency.doc()
+                    members[m.id]["active_model"] = m.active_model
             doc = {
                 "router": "f%d" % self._rid,
                 "generation": self._generation,
@@ -579,6 +680,10 @@ class FleetRouter:
             if self.member_inflight_limit:
                 doc["member_inflight_limit"] = \
                     self.member_inflight_limit
+            if self._catalog is not None:
+                doc["models"] = self._catalog.doc()
+                doc["resident_bytes_budget"] = self.resident_bytes
+                doc["paging"] = sorted(self._paging)
         scaler = self._autoscaler
         if scaler is not None:
             doc["autoscale"] = scaler.doc()
@@ -662,11 +767,25 @@ class FleetRouter:
                         self.breaker_cooldown, label=member.label)
                 self._members[mid] = member
                 fresh = True
+            # residency advertisement rides the REG like the version:
+            # a model-less worker sends no "models" field at all, so
+            # legacy frames stay byte-identical
+            if msg.get("models") is not None:
+                member.residency.update(msg.get("models"), gen,
+                                        self._catalog)
+                if msg.get("active_model") is not None:
+                    member.active_model = str(msg["active_model"])
+                resident_bytes = member.residency.nbytes()
+            else:
+                resident_bytes = None
             live = len(self._live_locked())
             self._gauge("generation").set(self._generation)
             self._gauge("live").set(live)
         _MEMBER_INFLIGHT.labels(member=member.label).set(
             member.inflight)
+        if resident_bytes is not None:
+            _paging.RESIDENT_BYTES.labels(member=member.label).set(
+                resident_bytes)
         if fresh:
             _log.structured("fleet_member_joined", member=mid,
                             generation=gen, live=live,
@@ -690,6 +809,21 @@ class FleetRouter:
             known = True
             mismatch = gen != self._generation
             generation = self._generation
+            # residency rides the beat, fenced by generation exactly
+            # like the world view it belongs to: a stale beat's
+            # advertisement is ignored (the member re-registers and
+            # re-advertises at the current generation)
+            resident_bytes = None
+            if not mismatch and msg.get("models") is not None:
+                m.residency.update(msg.get("models"), generation,
+                                   self._catalog)
+                if msg.get("active_model") is not None:
+                    m.active_model = str(msg["active_model"])
+                resident_bytes = m.residency.nbytes()
+            label = m.label
+        if resident_bytes is not None:
+            _paging.RESIDENT_BYTES.labels(member=label).set(
+                resident_bytes)
         # piggybacked registry snapshot: ingested outside the router
         # lock (the aggregator has its own), and even on a fenced
         # beat — a stale world view does not stale the numbers
@@ -740,6 +874,8 @@ class FleetRouter:
                 burn = self.slo.tick()
                 for tracker in self._tenant_slos.values():
                     tracker.tick()
+                for tracker in self._model_slos.values():
+                    tracker.tick()
             scaler = self._autoscaler
             if scaler is not None:
                 # the capacity control loop rides the membership
@@ -751,6 +887,17 @@ class FleetRouter:
                 except Exception as exc:
                     _log.structured("autoscale_tick_error",
                                     error=repr(exc)[:200])
+            if self.resident_bytes > 0:
+                # re-apply eviction pressure to members still over
+                # the byte budget: the page-in-time pass skips pinned
+                # victims (in-flight requests), so the monitor is
+                # where pressure lands once the pins drain
+                with self._lock:
+                    over = [m for m in self._members.values()
+                            if m.state in ("live", "canary") and
+                            m.residency.nbytes() > self.resident_bytes]
+                for m in over:
+                    self._evict_pressure(m)
             now = time.monotonic()
             with self._lock:
                 overdue = [m.id for m in self._members.values()
@@ -800,7 +947,8 @@ class FleetRouter:
 
     # -- request plane ----------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_ms=None, meta=False, seed=None, tenant=None):
+               deadline_ms=None, meta=False, seed=None, tenant=None,
+               model=None):
         """Route one generation request over the fleet; returns a
         Future of the generated ids (int64 array), or — with
         ``meta=True`` — of ``{"tokens", "version", "version_start",
@@ -815,9 +963,27 @@ class FleetRouter:
         armed it is admission-checked against that tenant's quota
         (:class:`TenantQuotaError` when over — ITS traffic sheds, not
         the fleet's) and carried end-to-end on every hop's envelope;
-        without a table it rides along for tracing only."""
+        without a table it rides along for tracing only.
+
+        ``model`` names the catalog model this request targets
+        (catalog-armed routers only; defaults to the tenant's catalog
+        mapping). The request routes residency-first and demand-pages
+        the model onto a member when nobody holds it."""
         if self._closed:
             raise RuntimeError("router is closed")
+        if self._catalog is not None:
+            if model is not None:
+                model = str(model)
+                if model not in self._catalog:
+                    raise ValueError(
+                        "model %r is not in the fleet catalog (%s)"
+                        % (model, self._catalog.ids()))
+            else:
+                model = self._catalog.for_tenant(tenant)
+        elif model is not None:
+            raise ValueError(
+                "submit(model=...) needs a model catalog "
+                "(the fleet_models flag or FleetRouter(models=...))")
         prompt = np.asarray(prompt, np.int64).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -833,12 +999,14 @@ class FleetRouter:
         req = _FleetRequest(prompt, max_new_tokens, eos_id, deadline,
                             meta,
                             seed=mint_seed() if seed is None else seed,
-                            tenant=tenant)
+                            tenant=tenant, model=model)
         if self._tenants is not None:
             req.tenant_entry = self._admit_tenant(req.tenant)
         mint_kw = {}
         if req.tenant is not None:
             mint_kw["tenant"] = req.tenant
+        if req.model is not None:
+            mint_kw["model"] = req.model
         req.ctx = _rtrace.mint("fleet.submit",
                                prompt_len=int(prompt.size),
                                router=self._rid, **mint_kw)
@@ -901,6 +1069,10 @@ class FleetRouter:
         if req.tenant_entry is not None:
             _TENANT_REQUEST_MS.labels(
                 tenant=req.tenant_entry.label).observe(e2e * 1e3)
+        if req.model is not None and self._catalog is not None:
+            _paging.MODEL_REQUEST_MS.labels(
+                model="f%d:%s" % (self._rid, req.model)).observe(
+                e2e * 1e3)
         self._tenant_done(req)
         if req.ctx is not None:
             _rtrace.event(req.ctx, "resolve", tokens=len(toks),
@@ -922,6 +1094,10 @@ class FleetRouter:
             # was already charged at the expiry site)
             _TENANT_DEADLINE.labels(
                 tenant=req.tenant_entry.label).inc()
+        if req.model is not None and self._catalog is not None and \
+                isinstance(exc, ServingDeadlineError):
+            _paging.MODEL_DEADLINE.labels(
+                model="f%d:%s" % (self._rid, req.model)).inc()
         self._tenant_done(req)
         if req.ctx is not None:
             _rtrace.event(req.ctx, "resolveError",
@@ -952,6 +1128,21 @@ class FleetRouter:
             if req.remaining() == 0:
                 self._resolve_ok(req)
                 return
+            if req.model is not None and self._catalog is not None:
+                # residency-or-page-in BEFORE placement — this runs
+                # on every loop iteration, so a journal whose model
+                # was paged out (or whose only resident member was
+                # SIGKILL'd mid-generation) re-pages the model on the
+                # target member before the re-drive
+                try:
+                    if not self._ensure_resident(req):
+                        self._resolve_err(req, _paging.PageInError(
+                            "model %r could not be paged onto any "
+                            "member" % req.model))
+                        return
+                except ServingDeadlineError as exc:
+                    self._resolve_err(req, exc)
+                    return
             m = self._acquire_member(req)
             if m is None:
                 self._resolve_err(
@@ -965,6 +1156,24 @@ class FleetRouter:
                 # router-side cache staleness, not a member failure:
                 # the journal was reset, retry (from the prompt) with
                 # no breaker charge and no replay burned
+                continue
+            except _ModelRetry:
+                # the member no longer holds the request's model
+                # (evicted between placement and dispatch): correct
+                # the residency view and re-drive — _ensure_resident
+                # re-pages first. Not a member failure: no breaker
+                # charge, no replay burned, but bounded so a
+                # pathological member can't spin the loop forever.
+                with self._lock:
+                    m.residency.drop(req.model)
+                    if m.active_model == req.model:
+                        m.active_model = None
+                req.model_retries += 1
+                if req.model_retries > max(3, self.replay_attempts):
+                    self._resolve_err(req, _paging.PageInError(
+                        "model %r kept vanishing from members that "
+                        "advertised it" % req.model))
+                    return
                 continue
             except Exception as exc:
                 # a read past call_timeout is a hang (socket.timeout
@@ -987,6 +1196,189 @@ class FleetRouter:
                 continue
             if done:
                 return
+
+    # -- model paging (PR 20) ---------------------------------------------
+    def _ensure_resident(self, req):
+        """Make ``req.model`` resident on at least one live member,
+        demand-paging it when nobody holds it. Returns True once a
+        resident member exists (placement affinity takes it from
+        there), False when paging failed within its budget.
+
+        Exactly one page-in per model runs fleet-wide at a time: the
+        first request through becomes the leader (an Event in
+        ``self._paging`` is the election), peers wait on it — a burst
+        of cold requests for one model costs one staged load, not a
+        stampede of them."""
+        model = req.model
+        spec = self._catalog.get(model)
+        attempts = 0
+        budget = self.page_timeout if self.page_timeout > 0 else 30.0
+        while True:
+            if req.deadline is not None and \
+                    time.monotonic() >= req.deadline:
+                _sres.DEADLINE_EXCEEDED.inc()
+                raise ServingDeadlineError(
+                    "deadline expired waiting for model %r to page "
+                    "in" % model)
+            leader = False
+            target = None
+            with self._lock:
+                if self._closed:
+                    return False
+                live = [m for m in self._members.values()
+                        if m.state in ("live", "canary")]
+                # a member this request already FAILED on may still
+                # advertise residency (SIGKILL'd but not yet swept by
+                # the heartbeat timeout): never count it — affinity
+                # must not trap a replay on a corpse
+                resident = [m for m in live
+                            if m.residency.resident(model) and
+                            m.id not in req.failed_on]
+                if not req.model_counted:
+                    req.model_counted = True
+                    (_paging.RESIDENCY_HITS if resident
+                     else _paging.RESIDENCY_MISSES).inc()
+                if resident:
+                    return True
+                evt = self._paging.get(model)
+                if evt is None:
+                    # leader election: page onto the least-loaded
+                    # member with no page-in already in flight —
+                    # exactly the spawn-target discipline, but the
+                    # capacity being added is a weight set, not a
+                    # process
+                    cands = sorted(
+                        (m for m in live if not m.paging),
+                        key=lambda m: (m.id in req.failed_on,
+                                       m.inflight, m.index))
+                    if cands:
+                        target = cands[0]
+                        target.paging = True
+                        evt = threading.Event()
+                        self._paging[model] = evt
+                        leader = True
+            if leader:
+                try:
+                    ok = self._page_in(target, model, spec)
+                finally:
+                    with self._lock:
+                        target.paging = False
+                        evt = self._paging.pop(model, None)
+                    if evt is not None:
+                        evt.set()
+                if ok:
+                    self._evict_pressure(target)
+                    return True
+                attempts += 1
+                if attempts >= 2:
+                    return False
+                continue
+            if evt is not None:
+                # follower: ride the leader's page-in, then re-check
+                evt.wait(budget)
+                continue
+            # nobody to page onto (no live members / all mid-page):
+            # wait out the placement window like _acquire_member does
+            attempts += 1
+            if attempts >= max(4, int(budget / 0.05)):
+                return False
+            time.sleep(0.05)
+
+    def _page_in(self, m, model, spec):
+        """One demand page-in on ``m``: the worker stages the
+        artifact through its swap gates (manifest-verified load ->
+        flip), bounded by ``model_page_timeout_ms``. A wedge or
+        failure is charged to the autoscaler's spawn-failure budget —
+        paging is capacity provisioning, and a wedging artifact must
+        halt the control loop before it thrashes the fleet."""
+        msg = {"cmd": "page_in", "model": model, "tag": spec.tag}
+        if spec.params_path is not None:
+            msg["params_path"] = spec.params_path
+        if spec.model_dir is not None:
+            msg["model_dir"] = spec.model_dir
+        timeout = self.page_timeout if self.page_timeout > 0 else 30.0
+        t0 = time.perf_counter()
+        rep = self._member_call(m, msg, timeout=timeout)
+        elapsed = time.perf_counter() - t0
+        if rep.get("ok"):
+            with self._lock:
+                m.residency.add(model, spec.nbytes())
+                m.active_model = str(rep.get("model") or model)
+                m.version = rep.get("version", m.version)
+                resident_bytes = m.residency.nbytes()
+            _paging.RESIDENT_BYTES.labels(member=m.label).set(
+                resident_bytes)
+            _paging.PAGE_INS.labels(outcome="ok").inc()
+            _paging.PAGE_IN_MS.observe(elapsed * 1e3)
+            _log.structured("fleet_model_paged_in", member=m.id,
+                            model=model, ms=round(elapsed * 1e3, 1))
+            _rtrace.global_event("fleetModelPageIn", member=m.id,
+                                 model=model)
+            return True
+        outcome = "timeout" if elapsed >= timeout else "fail"
+        _paging.PAGE_INS.labels(outcome=outcome).inc()
+        _log.structured("fleet_model_page_in_failed", member=m.id,
+                        model=model, outcome=outcome,
+                        error=str(rep.get("error"))[:200])
+        scaler = self._autoscaler
+        if scaler is not None:
+            # the PR-18 budget: a wedged/failed page-in spends one
+            # spawn failure — enough of them halts provisioning and
+            # dumps a flight bundle instead of thrashing
+            scaler.charge_failure("page_in")
+        return False
+
+    def _evict_pressure(self, m):
+        """LRU page-outs until ``m``'s resident-set bytes fit the
+        ``member_resident_bytes`` budget. NEVER a model with
+        in-flight requests (the pin refcount — an invariant assert,
+        not a counter) and never the active model; a fully-pinned
+        over-budget set simply stays over budget until something
+        drains."""
+        if self.resident_bytes <= 0:
+            return
+        with self._lock:
+            protect = ((m.active_model,)
+                       if m.active_model is not None else ())
+            victims = m.residency.lru_victims(self.resident_bytes,
+                                              protect=protect)
+        for victim in victims:
+            try:
+                # the race window under test: between victim
+                # selection and the page-out, a request can pin the
+                # victim — eviction must re-check, not race
+                _faults.fire_point("model_evict_race", index=victim)
+            except Exception:
+                return  # injected abort: no page-out this round
+            with self._lock:
+                if victim == m.active_model or \
+                        m.residency.pinned(victim) > 0:
+                    continue  # pinned since selection: not a victim
+                # the eviction invariant, asserted at the last gate
+                # before the page-out leaves the router
+                assert m.residency.pinned(victim) == 0, \
+                    "evicting model %r with in-flight requests" \
+                    % victim
+                entry = m.residency.models.get(victim)
+                nbytes = 0 if entry is None else entry.nbytes
+                # drop from the routing view FIRST: from this instant
+                # no new request can pin the victim on this member
+                m.residency.drop(victim)
+            rep = self._member_call(
+                m, {"cmd": "page_out", "model": victim}, timeout=10.0)
+            if not rep.get("ok"):
+                with self._lock:
+                    m.residency.add(victim, nbytes)
+                continue
+            with self._lock:
+                resident_bytes = m.residency.nbytes()
+            _paging.RESIDENT_BYTES.labels(member=m.label).set(
+                resident_bytes)
+            _paging.EVICTIONS.inc()
+            _log.structured("fleet_model_evicted", member=m.id,
+                            model=victim, resident_bytes=resident_bytes)
+            _rtrace.global_event("fleetModelEvict", member=m.id,
+                                 model=victim)
 
     def _acquire_member(self, req):
         """A member to dispatch to (inflight already counted), or
@@ -1025,6 +1417,13 @@ class FleetRouter:
                     m = None if behind else self._pick_locked(req)
                     if m is not None:
                         m.inflight += 1
+                        if req.model is not None:
+                            # the in-flight pin: from here to release
+                            # this model can NEVER be an eviction
+                            # victim on this member (BlockPool's
+                            # refcount rule, weight-set sized)
+                            m.residency.pin(req.model)
+                            m.residency.touch(req.model)
                         _MEMBER_INFLIGHT.labels(member=m.label).set(
                             m.inflight)
                         return m
@@ -1059,6 +1458,16 @@ class FleetRouter:
                     if m.inflight < self.member_inflight_limit]
         if not live:
             return None
+        if req.model is not None:
+            # residency affinity: members already holding the
+            # request's model win placement outright (item 2's prefix
+            # affinity, keyed on model id) — falling back to the full
+            # set only when nobody holds it (the hop then pages in on
+            # demand or errs kind="model" and re-drives)
+            resident = [m for m in live
+                        if m.residency.resident(req.model) and
+                        m.id not in req.failed_on]
+            live = resident or live
         canary = self._canary
         if canary is not None:
             if req.canary is None:
@@ -1100,9 +1509,11 @@ class FleetRouter:
                 return m  # nothing closed: trial traffic rides along
         return None
 
-    def _release_member(self, m):
+    def _release_member(self, m, model=None):
         with self._lock:
             m.inflight = max(0, m.inflight - 1)
+            if model is not None:
+                m.residency.unpin(model)
             inflight = m.inflight
             dead = m.state == "dead"
         if not dead:
@@ -1134,13 +1545,36 @@ class FleetRouter:
         try:
             _faults.fire_point("fleet_network_partition", index=m.id,
                                default_exc=ConnectionError)
-            if req.tokens and req.tokens_version != m.version:
+            # when the hop names a model the member holds but isn't
+            # serving, the worker activates it before acking — the
+            # cached version/model say nothing about THIS hop, so the
+            # pre-hop fences stand down and the ack checks decide
+            will_activate = (req.model is not None and
+                             m.active_model is not None and
+                             m.active_model != req.model)
+            if req.tokens and req.tokens_model is not None and \
+                    req.model is None and \
+                    m.active_model is not None and \
+                    req.tokens_model != m.active_model:
+                # the model fence, cached side: a journal generated
+                # on one model must never splice onto another — a
+                # two-model fleet serving model-less requests resets
+                # here instead of mixing models in one response
+                _JOURNAL_RESETS.labels(reason="model").inc()
+                if req.ctx is not None:
+                    _rtrace.event(req.ctx, "journalReset",
+                                  from_model=req.tokens_model,
+                                  to_model=m.active_model,
+                                  discarded=len(req.tokens))
+                req.tokens = []
+            if req.tokens and not will_activate and \
+                    req.tokens_version != m.version:
                 # the journal was generated under different weights:
                 # re-driving it here would splice two versions into
                 # one response. Discard and restart from the prompt —
                 # determinism makes the restart exact, versioning
                 # makes it honest.
-                _JOURNAL_RESETS.inc()
+                _JOURNAL_RESETS.labels(reason="version").inc()
                 if req.ctx is not None:
                     _rtrace.event(req.ctx, "journalReset",
                                   from_version=req.tokens_version,
@@ -1155,7 +1589,7 @@ class FleetRouter:
                 # a sampled continuation is neither policy's answer).
                 # m.policy None = member never acked yet; the ack
                 # recheck below covers that hop.
-                _JOURNAL_RESETS.inc()
+                _JOURNAL_RESETS.labels(reason="policy").inc()
                 if req.ctx is not None:
                     _rtrace.event(req.ctx, "journalReset",
                                   from_policy=req.tokens_policy,
@@ -1196,8 +1630,15 @@ class FleetRouter:
                     # replay lands on the peer still attributed to
                     # its tenant (worker-side sheds, traces)
                     env["tenant"] = req.tenant
+                if req.model is not None:
+                    # the model rides every hop too: the worker
+                    # activates it (resident) or refuses the hop
+                    # (kind="model" -> re-page and re-drive) — a
+                    # journal never lands on the wrong weights
+                    env["model"] = req.model
                 conn.send(env)
                 hop_start = len(req.tokens)
+                ack_model = None
                 while True:
                     msg = conn.recv()
                     if msg is None:
@@ -1212,6 +1653,7 @@ class FleetRouter:
                         ack_version = msg.get("version")
                         ack_policy = msg.get("policy",
                                              GREEDY_FINGERPRINT)
+                        ack_model = msg.get("model")
                         req.version_start = ack_version
                         if req.eos_id is None and \
                                 msg.get("eos_id") is not None:
@@ -1219,6 +1661,40 @@ class FleetRouter:
                         with self._lock:
                             m.version = ack_version or m.version
                             m.policy = ack_policy or m.policy
+                            if ack_model is not None:
+                                m.active_model = str(ack_model)
+                                if m.residency.resident(ack_model):
+                                    m.residency.touch(ack_model)
+                                else:
+                                    nb = 0
+                                    if self._catalog is not None and \
+                                            str(ack_model) in \
+                                            self._catalog:
+                                        nb = self._catalog.get(
+                                            ack_model).nbytes()
+                                    m.residency.add(ack_model, nb)
+                        if req.tokens and \
+                                req.tokens_model is not None and \
+                                ack_model is not None and \
+                                req.tokens_model != str(ack_model):
+                            # the model fence, authoritative side:
+                            # the ack names the model this hop will
+                            # actually decode under. A journal from
+                            # another model is discarded BEFORE any
+                            # of this hop's tokens land — counted
+                            # under reason="model", and the request
+                            # restarts from the prompt.
+                            _JOURNAL_RESETS.labels(
+                                reason="model").inc()
+                            if req.ctx is not None:
+                                _rtrace.event(
+                                    req.ctx, "journalReset",
+                                    from_model=req.tokens_model,
+                                    to_model=str(ack_model),
+                                    discarded=len(req.tokens),
+                                    at="ack")
+                            del req.tokens[:]
+                            raise _VersionRetry()
                         if req.tokens and \
                                 req.tokens_policy != ack_policy:
                             # the authoritative decode-policy check:
@@ -1227,7 +1703,8 @@ class FleetRouter:
                             # learned (fresh join, restart). Same
                             # abandon-and-retry as a version skew —
                             # no spliced-policy response, ever.
-                            _JOURNAL_RESETS.inc()
+                            _JOURNAL_RESETS.labels(
+                                reason="policy").inc()
                             if req.ctx is not None:
                                 _rtrace.event(
                                     req.ctx, "journalReset",
@@ -1248,7 +1725,8 @@ class FleetRouter:
                             # tokens land and retry from the prompt:
                             # a mixed-version response is never
                             # served, whoever swapped the member.
-                            _JOURNAL_RESETS.inc()
+                            _JOURNAL_RESETS.labels(
+                                reason="version").inc()
                             if req.ctx is not None:
                                 _rtrace.event(
                                     req.ctx, "journalReset",
@@ -1274,6 +1752,9 @@ class FleetRouter:
                         req.tokens.append(int(msg["t"]))
                         req.tokens_version = m.version
                         req.tokens_policy = m.policy
+                        req.tokens_model = (str(ack_model)
+                                            if ack_model is not None
+                                            else req.model)
                     elif ev == "done":
                         with self._lock:
                             fenced = m.state == "dead"
@@ -1301,6 +1782,9 @@ class FleetRouter:
                         req.member = m.id
                         req.tokens_version = req.version
                         req.tokens_policy = m.policy
+                        req.tokens_model = (str(ack_model)
+                                            if ack_model is not None
+                                            else req.model)
                         with self._lock:
                             m.served += 1
                             m.version = req.version
@@ -1330,6 +1814,12 @@ class FleetRouter:
                             self._resolve_err(
                                 req, ValueError(msg.get("error", "")))
                             return True
+                        if kind == "model":
+                            # the model isn't resident there after
+                            # all (evicted between placement and
+                            # dispatch): not a member failure — the
+                            # serve loop re-pages and re-drives
+                            raise _ModelRetry(msg.get("error", ""))
                         raise _MemberError(
                             "member %s failed the request: %s"
                             % (m.id, msg.get("error", "")))
@@ -1338,7 +1828,7 @@ class FleetRouter:
                     m.conns.discard(conn)
                 conn.close()
         finally:
-            self._release_member(m)
+            self._release_member(m, req.model)
 
     # -- rolling deploy ---------------------------------------------------
     def _drain_member(self, m, timeout):
@@ -1393,7 +1883,8 @@ class FleetRouter:
     def rolling_deploy(self, params_path=None, tag=None,
                        model_dir=None, canary_requests=6,
                        watch_failures=2, watch_timeout=30.0,
-                       drain_timeout=30.0, swap_timeout=120.0):
+                       drain_timeout=30.0, swap_timeout=120.0,
+                       model_id=None):
         """Roll a weights push through the fleet, one member at a
         time: drain -> swap (the worker's PR-7/PR-9 gates apply) ->
         canary-scope ``canary_fraction`` of live traffic to it ->
@@ -1404,15 +1895,29 @@ class FleetRouter:
 
         ``params_path`` (an ``.npz`` of {name: array}) feeds
         generation-scheduler workers; ``model_dir`` feeds stateless
-        engine workers (``ServingEngine.swap_weights``)."""
+        engine workers (``ServingEngine.swap_weights``).
+
+        ``model_id`` scopes the deploy to one catalog model: only
+        members RESIDENT for that model drain/swap/canary (each
+        activates the model before applying the push), and other
+        models' traffic rides on untouched — a multi-model fleet
+        deploys one model without draining the others' members."""
         if not self._deploy_lock.acquire(blocking=False):
             raise RuntimeError("a rolling deploy is already running")
         try:
+            model_id = None if model_id is None else str(model_id)
             with self._lock:
-                order = sorted(m.id for m in self._members.values()
-                               if m.state == "live")
+                order = sorted(
+                    m.id for m in self._members.values()
+                    if m.state == "live" and
+                    (model_id is None or
+                     m.residency.resident(model_id) or
+                     m.active_model == model_id))
             if not order:
-                return {"ok": False, "reason": "no live members",
+                return {"ok": False, "reason": "no live members"
+                        if model_id is None else
+                        "no live members resident for model %r"
+                        % model_id,
                         "rolled_back": False, "swapped": []}
             swapped = []
             swap_msg = {"cmd": "swap", "tag": tag}
@@ -1420,8 +1925,10 @@ class FleetRouter:
                 swap_msg["params_path"] = str(params_path)
             if model_dir is not None:
                 swap_msg["model_dir"] = str(model_dir)
+            if model_id is not None:
+                swap_msg["model"] = model_id
             _log.structured("fleet_deploy_start", tag=tag,
-                            members=order)
+                            members=order, model=model_id)
             for mid in order:
                 with self._lock:
                     m = self._members.get(mid)
@@ -1451,6 +1958,11 @@ class FleetRouter:
                     m.failures = 0
                     m.state = "canary"
                     self._canary = mid
+                    if model_id is not None:
+                        # the worker activated model_id to apply the
+                        # push — the router's view follows
+                        m.active_model = model_id
+                        m.residency.touch(model_id)
                 swapped.append(mid)
                 ok = self._watch_canary(m, canary_requests,
                                         watch_failures, watch_timeout)
@@ -1471,6 +1983,18 @@ class FleetRouter:
                             % mid,
                             "failed_member": mid, "swapped": swapped}
             _DEPLOYS.labels(outcome="committed").inc()
+            if model_id is not None and self._catalog is not None \
+                    and tag is not None and \
+                    model_id in self._catalog:
+                # future page-ins of this model must land the pushed
+                # version, not the pre-deploy artifact's tag
+                self._catalog.get(model_id).tag = str(tag)
+                if params_path is not None:
+                    self._catalog.get(model_id).params_path = \
+                        str(params_path)
+                if model_dir is not None:
+                    self._catalog.get(model_id).model_dir = \
+                        str(model_dir)
             _log.structured("fleet_deploy_committed", tag=tag,
                             members=swapped)
             return {"ok": True, "rolled_back": False, "version": tag,
@@ -1529,9 +2053,15 @@ class FleetRouter:
         for tracker in self._tenant_slos.values():
             tracker.close()
         self._tenant_slos = {}
+        for tracker in self._model_slos.values():
+            tracker.close()
+        self._model_slos = {}
         if self._tenants is not None:
             # per-tenant children share the router's label namespace
             _metrics.REGISTRY.remove_labeled("tenant", prefix=prefix)
+        if self._catalog is not None:
+            # per-model children share it too
+            _metrics.REGISTRY.remove_labeled("model", prefix=prefix)
         scaler = self._autoscaler
         if scaler is not None:
             scaler.close()   # detaches itself; reaps pending spawns
@@ -1607,6 +2137,12 @@ def _router_slo(ref):
             doc["tenants"] = {
                 tid: tracker.verdict() for tid, tracker
                 in sorted(router._tenant_slos.items())}
+        if router._model_slos:
+            # per-model verdicts too: paging churn on one model must
+            # not paint its co-resident's verdict red
+            doc["models"] = {
+                model_id: tracker.verdict() for model_id, tracker
+                in sorted(router._model_slos.items())}
         return doc
     return provider
 
@@ -1642,12 +2178,25 @@ class EngineWorker:
     swap landing that tag arms a persistent ``generation_step_fail``
     (the stand-in for a broken weights push), disarmed again by the
     rollback that restores the prior version.
+
+    ``model`` names the catalog model this worker starts resident
+    for (multi-model fleets, PR 20). A model-named worker advertises
+    its resident set + active model on REG and every heartbeat,
+    answers ``page_in`` (manifest-verified staged load through the
+    swap gates; the paged model becomes active) and ``page_out``
+    (drops a non-active resident model's host snapshot), activates
+    the model a ``generate`` envelope names (resident -> fast swap
+    from the host snapshot; non-resident -> ``kind="model"`` error,
+    the router re-pages and re-drives), and acks the model id — the
+    router's third journal fence. ``model=None`` (default) sends
+    none of these fields: legacy frames stay byte-identical.
     """
 
     def __init__(self, backend, host="127.0.0.1", port=0,
                  member_id=None, router_addr=None, heartbeat_ms=None,
                  version="v0", fail_after_swap_tag=None,
-                 autostart=True, metrics_interval_ms=None):
+                 autostart=True, metrics_interval_ms=None,
+                 model=None):
         self.backend = backend
         self._kind = ("generation" if hasattr(backend, "sessions")
                       else "engine")
@@ -1697,6 +2246,24 @@ class EngineWorker:
         self._prev = None          # (version, params/model_dir) snapshot
         self._armed_bad = False
         self._swap_lock = threading.Lock()
+        # in-flight generation streams: a model activation (page-in,
+        # demand activation, model-scoped deploy) drains this count
+        # to zero under _swap_lock before swapping weights, so no
+        # stream ever finishes its tokens on another model's weights
+        self._gen_cv = threading.Condition()
+        self._gen_active = 0
+        # multi-model residency (PR 20): model id -> {"tag",
+        # "params" (host snapshot, generation kind; None while the
+        # weights live only in the scope), "model_dir" (engine
+        # kind)}. The ACTIVE model's weights are in the backend; a
+        # paged-but-inactive model is a host-side snapshot waiting
+        # for a fast activation swap.
+        self.model = None if model is None else str(model)
+        self._resident = {}
+        if self.model is not None:
+            self._resident[self.model] = {
+                "tag": self.version, "params": None,
+                "model_dir": getattr(self, "_cur_dir", None)}
         self.generation = 0
         self._host, self._port = host, port
         self._server = None
@@ -1734,11 +2301,27 @@ class EngineWorker:
         return self
 
     # -- membership -------------------------------------------------------
+    def _residency_fields(self, msg):
+        """Stamp the residency advertisement onto a REG/HB frame —
+        only for model-named workers, so legacy frames stay
+        byte-identical. Lock-free on purpose (the heartbeat must
+        never stall behind a long page-in): a beat that races a
+        mutation just skips the fields until the next one."""
+        if self.model is None:
+            return msg
+        try:
+            msg["models"] = sorted(self._resident)
+            msg["active_model"] = self.model
+        except RuntimeError:
+            pass  # resident set mutating mid-iteration: next beat
+        return msg
+
     def _register(self):
         rep = _wire.call_once(
             self.router_addr,
-            {"cmd": "reg", "member": self.member_id,
-             "addr": list(self.addr), "version": self.version},
+            self._residency_fields(
+                {"cmd": "reg", "member": self.member_id,
+                 "addr": list(self.addr), "version": self.version}),
             timeout=5.0, retries=5)
         if not rep.get("ok"):
             raise RuntimeError("fleet registration refused: %r" % rep)
@@ -1752,8 +2335,9 @@ class EngineWorker:
             if _faults.should_fire("fleet_network_partition",
                                    self.member_id):
                 continue  # injected partition: the beat never leaves
-            msg = {"cmd": "hb", "member": self.member_id,
-                   "generation": self.generation}
+            msg = self._residency_fields(
+                {"cmd": "hb", "member": self.member_id,
+                 "generation": self.generation})
             if self.metrics_interval > 0:
                 now = time.monotonic()
                 if now >= self._next_ship:
@@ -1788,11 +2372,19 @@ class EngineWorker:
             return self._handle_run(conn, msg)
         if cmd == "swap":
             conn.send(self._handle_swap(msg))
+        elif cmd == "page_in":
+            conn.send(self._handle_page_in(msg))
+        elif cmd == "page_out":
+            conn.send(self._handle_page_out(msg))
         elif cmd == "rollback":
             conn.send(self._handle_rollback())
         elif cmd == "health":
-            conn.send({"ok": True, "member": self.member_id,
-                       "version": self.version, "pid": os.getpid()})
+            rep = {"ok": True, "member": self.member_id,
+                   "version": self.version, "pid": os.getpid()}
+            if self.model is not None:
+                rep["model"] = self.model
+                rep["models"] = sorted(self._resident)
+            conn.send(rep)
         elif cmd == "stop":
             conn.send({"ok": True})
             self._stop_evt.set()
@@ -1819,13 +2411,61 @@ class EngineWorker:
         if ctx is not None:
             _rtrace.event(ctx, "memberRecv", member=self.member_id,
                           pid=os.getpid(), version=self.version)
+        env_model = msg.get("model")
+        with self._swap_lock:
+            if env_model is not None:
+                env_model = str(env_model)
+                if env_model != self.model:
+                    if env_model not in self._resident:
+                        # paged out between the router's placement
+                        # and this dispatch (the evict race): refuse
+                        # — the router re-pages and re-drives, never
+                        # decodes on the wrong weights
+                        conn.send({
+                            "ev": "err", "kind": "model",
+                            "error": "model %r not resident on %s "
+                            "(resident: %s)" % (
+                                env_model, self.member_id,
+                                sorted(self._resident))})
+                        return
+                    try:
+                        # demand activation: fast swap from the host
+                        # snapshot, through the same gates a deploy
+                        # push takes
+                        self._activate_locked(env_model)
+                    except Exception as exc:
+                        conn.send({"ev": "err", "kind": "model",
+                                   "error": repr(exc)[:300]})
+                        return
+            # count this stream in while still under the swap lock:
+            # an activation drains the count to zero before swapping
+            # weights, and no new stream can pass this gate while an
+            # activator holds the lock — a stream's tokens all come
+            # from the model that was active when it was admitted
+            with self._gen_cv:
+                self._gen_active += 1
+        try:
+            self._stream_generation(conn, msg, ctx)
+        finally:
+            with self._gen_cv:
+                self._gen_active -= 1
+                self._gen_cv.notify_all()
+
+    def _stream_generation(self, conn, msg, ctx):
+        """The streaming half of a generate request, counted in
+        ``_gen_active`` by the caller (:meth:`_handle_generate`)."""
         eos_id = msg.get("eos_id")
         if eos_id is None:
             eos_id = int(self.backend.sessions[0].spec.eos_id)
-        conn.send({"ev": "ack", "member": self.member_id,
-                   "pid": os.getpid(), "version": self.version,
-                   "policy": self._policy_fp,
-                   "eos_id": int(eos_id)})
+        ack = {"ev": "ack", "member": self.member_id,
+               "pid": os.getpid(), "version": self.version,
+               "policy": self._policy_fp,
+               "eos_id": int(eos_id)}
+        if self.model is not None:
+            # the model id the router fences journals on: absent for
+            # model-less workers, so legacy acks stay byte-identical
+            ack["model"] = self.model
+        conn.send(ack)
         tokq = queue.Queue()
         version_start = self.version
         kw = {}
@@ -1926,10 +2566,145 @@ class EngineWorker:
             conn.send({"ev": "err", "kind": "server",
                        "error": repr(exc)[:300]})
 
+    # -- model paging (PR 20) ---------------------------------------------
+    def _activate_locked(self, model):
+        """Make ``model`` (already resident) the active one: snapshot
+        the outgoing model's live weights host-side, then swap the
+        incoming snapshot in through the backend's gates. Caller
+        holds ``_swap_lock``."""
+        entry = self._resident[model]
+        if self._kind == "generation":
+            # drain in-flight streams first: the scheduler's swap
+            # lands between decode steps, so without this a stream
+            # admitted under the OUTGOING model would finish its
+            # remaining tokens on the incoming model's weights —
+            # cross-model output the version fence can't unmix
+            with self._gen_cv:
+                deadline = time.monotonic() + 60.0
+                while self._gen_active:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise RuntimeError(
+                            "activating %r timed out draining %d "
+                            "in-flight generation stream(s)"
+                            % (model, self._gen_active))
+                    self._gen_cv.wait(left)
+            params = entry["params"]
+            if params is None:
+                raise RuntimeError(
+                    "model %r resident without a host snapshot"
+                    % model)
+            # the outgoing model keeps its weights: snapshot the live
+            # values of exactly the vars about to be overwritten
+            # (paged models share the program's parameter set — the
+            # same contract a rolling-deploy push has)
+            cur = self._resident.get(self.model)
+            if cur is not None:
+                scope = self.backend.sessions[0].scope
+                snap = {}
+                for name in params:
+                    var = scope.find_var(name)
+                    if var is not None:
+                        snap[name] = np.array(var, copy=True)
+                cur["params"] = snap
+            self.backend.swap_weights(params)
+        else:
+            if entry.get("model_dir") is None:
+                raise RuntimeError(
+                    "model %r resident without an artifact dir"
+                    % model)
+            self.backend.swap_weights(entry["model_dir"])
+            self._cur_dir = entry["model_dir"]
+        self.model = model
+        self.version = str(entry["tag"])
+
+    def _handle_page_in(self, msg):
+        model = str(msg.get("model"))
+        tag = msg.get("tag") or "%s@v0" % model
+        with self._swap_lock:
+            inserted = False
+            try:
+                # chaos first: a wedged/failing page-in must look
+                # exactly like a wedged staged load to the router
+                _faults.fire_point("model_page_in_slow", index=model)
+                _faults.fire_point("model_page_in_fail", index=model)
+                if model == self.model:
+                    pass  # already active: idempotent success
+                elif model in self._resident:
+                    # already resident (router raced itself or a
+                    # stale view): just activate the snapshot
+                    self._activate_locked(model)
+                elif self._kind == "generation":
+                    path = msg["params_path"]
+                    # the manifest gate: a truncated or switched
+                    # artifact is refused BEFORE any weight lands
+                    _paging.verify_weights_manifest(path)
+                    params = {k: np.asarray(v) for k, v in
+                              np.load(path).items()}
+                    self._resident[model] = {"tag": str(tag),
+                                             "params": params,
+                                             "model_dir": None}
+                    inserted = True
+                    self._activate_locked(model)
+                else:
+                    self._resident[model] = {
+                        "tag": str(tag), "params": None,
+                        "model_dir": msg["model_dir"]}
+                    inserted = True
+                    self._activate_locked(model)
+            except Exception as exc:
+                if inserted:
+                    self._resident.pop(model, None)
+                return {"ok": False, "error": repr(exc)[:300],
+                        "version": self.version, "model": self.model}
+        _log.structured("fleet_worker_paged_in",
+                        member=self.member_id, model=model,
+                        version=self.version,
+                        resident=sorted(self._resident))
+        return {"ok": True, "version": self.version,
+                "model": self.model,
+                "models": sorted(self._resident)}
+
+    def _handle_page_out(self, msg):
+        model = str(msg.get("model"))
+        with self._swap_lock:
+            if model == self.model:
+                # the active model's weights live in the backend —
+                # paging it out would leave the member serving
+                # nothing (the router protects the active model, so
+                # reaching this is a bug or a raced view)
+                return {"ok": False, "version": self.version,
+                        "error": "model %r is active" % model}
+            if self._resident.pop(model, None) is None:
+                return {"ok": False, "version": self.version,
+                        "error": "model %r not resident" % model}
+        _log.structured("fleet_worker_paged_out",
+                        member=self.member_id, model=model,
+                        resident=sorted(self._resident))
+        return {"ok": True, "version": self.version,
+                "models": sorted(self._resident)}
+
     # -- deploys ----------------------------------------------------------
     def _handle_swap(self, msg):
         tag = str(msg.get("tag"))
         with self._swap_lock:
+            swap_model = msg.get("model")
+            if swap_model is not None and \
+                    str(swap_model) != self.model:
+                # a model-scoped deploy lands on the named model, not
+                # whatever happens to be active: activate it first
+                # (resident members only — the router already scoped
+                # the deploy order to them)
+                swap_model = str(swap_model)
+                if swap_model not in self._resident:
+                    return {"ok": False, "version": self.version,
+                            "error": "model %r not resident on %s"
+                            % (swap_model, self.member_id)}
+                try:
+                    self._activate_locked(swap_model)
+                except Exception as exc:
+                    return {"ok": False, "error": repr(exc)[:300],
+                            "version": self.version}
             try:
                 if self._kind == "generation":
                     # host-side rollback snapshot of exactly the
@@ -1955,6 +2730,17 @@ class EngineWorker:
             self._prev = (self.version, snapshot)
             prev_tag = self.version
             self.version = tag
+            if self.model is not None:
+                # the active model's resident entry tracks the push:
+                # paging away and back must restore the PUSHED
+                # weights, not the pre-deploy snapshot
+                entry = self._resident.get(self.model)
+                if entry is not None:
+                    entry["tag"] = tag
+                    if self._kind == "generation":
+                        entry["params"] = params
+                    else:
+                        entry["model_dir"] = msg["model_dir"]
             if self._armed_bad:
                 _faults.disarm("generation_step_fail")
                 self._armed_bad = False
@@ -1986,6 +2772,16 @@ class EngineWorker:
                         "version": self.version}
             self.version = prev_tag
             self._prev = None
+            if self.model is not None:
+                # the rollback restored the prior weights: the
+                # active model's resident entry follows
+                entry = self._resident.get(self.model)
+                if entry is not None:
+                    entry["tag"] = prev_tag
+                    if self._kind == "generation":
+                        entry["params"] = snapshot
+                    else:
+                        entry["model_dir"] = snapshot
             if self._armed_bad:
                 _faults.disarm("generation_step_fail")
                 self._armed_bad = False

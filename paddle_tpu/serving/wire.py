@@ -31,11 +31,22 @@ gates replay-journal reuse on it exactly as it gates on the weights
 discipline: stamped once at the router's front door, re-sent on every
 replay hop (the journal lives router-side), absent entirely for
 single-tenant traffic so pre-tenant frames stay byte-identical.
+PR 20 adds the optional ``model`` field the same way: the generate
+envelope names the catalog model the hop must decode under (the
+worker activates it or refuses with ``kind="model"``), the ack
+carries the member's active model id — the router's third
+journal-reuse fence beside ``version`` and ``policy`` — and
+``reg``/``hb`` frames from model-named workers carry ``models`` (the
+resident set) + ``active_model``; model-less workers send none of
+these, so pre-paging frames stay byte-identical.
 Control verbs: ``reg``/``hb``/``unreg`` (membership), ``swap``/
-``rollback`` (deploys), ``health``, ``metrics`` (final snapshot
-ship), and ``stop`` — the drain-then-exit verb the autoscaler's
-retire path sends (a subprocess worker's ``serve_forever`` unblocks,
-closes, and unregisters).
+``rollback`` (deploys), ``page_in``/``page_out`` (multi-model weight
+paging: manifest-verified staged load through the swap gates /
+resident-snapshot drop — serving/model_paging.py), ``health``,
+``metrics`` (final snapshot ship), and ``stop`` — the
+drain-then-exit verb the autoscaler's retire path sends (a
+subprocess worker's ``serve_forever`` unblocks, closes, and
+unregisters).
 
 Nothing here is constructed by default flags — the module has no
 import-time side effects beyond defining classes.

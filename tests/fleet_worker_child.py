@@ -140,6 +140,9 @@ def main():
     ap.add_argument("--heartbeat-ms", type=float, default=None)
     ap.add_argument("--metrics-interval-ms", type=float, default=None)
     ap.add_argument("--version", default="v0")
+    ap.add_argument("--model", default=None,
+                    help="catalog model this worker starts resident "
+                    "for (multi-model fleets)")
     args = ap.parse_args()
 
     import paddle_tpu as ptpu
@@ -167,7 +170,8 @@ def main():
         sched, member_id=args.member, router_addr=(host, int(port)),
         heartbeat_ms=args.heartbeat_ms, version=args.version,
         fail_after_swap_tag=args.fail_after_swap,
-        metrics_interval_ms=args.metrics_interval_ms)
+        metrics_interval_ms=args.metrics_interval_ms,
+        model=args.model)
     print("READY %s %d" % (args.member, worker.addr[1]), flush=True)
     try:
         worker.serve_forever()

@@ -37,9 +37,11 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 
 
 def counter(name):
-    for s in metrics.REGISTRY.dump().get(name, {}).get("samples", ()):
-        return s["value"]
-    return 0.0
+    # sum across labeled children (e.g. the journal-resets family is
+    # labeled by reason since PR 20)
+    return sum(
+        s["value"]
+        for s in metrics.REGISTRY.dump().get(name, {}).get("samples", ()))
 
 
 def fake_next(hist):
@@ -743,8 +745,13 @@ class TestDefaultsOffHotPath:
             assert [c for c in calls
                     if c.startswith(("fleet_", "slo_", "autoscale_"))] \
                 == ["fleet_canary_fraction", "fleet_members_min",
-                    "fleet_tenants", "fleet_metrics_interval_ms",
+                    "fleet_tenants", "fleet_models",
+                    "fleet_metrics_interval_ms",
                     "slo_target_p99_ms"]
+            # the paging sizing flags are gated behind an armed model
+            # catalog: defaults never touch them
+            assert "member_resident_bytes" not in calls
+            assert "model_page_timeout_ms" not in calls
             # the windows flag is gated behind a nonzero SLO target:
             # defaults never touch it
             assert "slo_windows" not in calls

@@ -165,6 +165,41 @@ class TestInt8Compute:
             eng.close()
         np.testing.assert_allclose(np.asarray(got), want, atol=0.02)
 
+    def test_f32_push_to_int8_engine_swaps_and_rolls_back(
+            self, tmp_path):
+        """Regression: an int8-armed engine must accept a PLAIN f32
+        weight push (no quant.json) — the staged scope is quantized
+        in place so the signature gate sees int8 + @quant.scale like
+        the live weights, instead of rejecting every f32 deploy (and
+        the rollback after it) on a dtype mismatch."""
+        with ptpu.unique_name.guard():
+            d_q, feed, want_q = _export_fc(tmp_path, quantize="int8")
+        with ptpu.unique_name.guard():
+            d_f, _, want_f = _export_fc(tmp_path, quantize=None)
+        names = json.load(open(os.path.join(d_q, "quant.json")))["vars"]
+        ptpu.config.set_flags(serving_quant_compute=True)
+        eng = ServingEngine(d_q, buckets=(8,), warmup=False)
+        try:
+            eng.swap_weights(d_f, watch_requests=0)
+            got_f, = eng.run({"x": feed})
+            # the pushed weights serve (as int8: quantization noise
+            # only), and the scope stayed int8-armed — no f32 copy
+            # snuck in through the staging path
+            np.testing.assert_allclose(np.asarray(got_f), want_f,
+                                       atol=0.02)
+            scope = eng.replicas[0].scope
+            for name in names:
+                assert np.asarray(
+                    scope.find_var(name)).dtype == np.int8
+            # the "rollback" shape: re-push the original quantized
+            # artifact — the prior outputs come back
+            eng.swap_weights(d_q, watch_requests=0)
+            got_q, = eng.run({"x": feed})
+            np.testing.assert_allclose(np.asarray(got_q), want_q,
+                                       atol=0.02)
+        finally:
+            eng.close()
+
 
 # -- decode: int8 LM agreement, session arming ---------------------------
 

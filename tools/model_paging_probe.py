@@ -1,0 +1,291 @@
+"""Multi-model paging chaos probe: two tenants -> two models on a
+3-member fleet — cold page-in, affinity steady state, forced LRU
+eviction, and a mid-request SIGKILL of the ONLY member resident for
+model B — headless, self-asserting.
+
+The multi-model counterpart of ``tools/autoscale_chaos_probe.py``:
+three engine-worker processes (identical model-A weights, warm
+persistent compile cache) behind a :class:`FleetRouter` whose model
+catalog maps tenant ``acme`` -> model A and ``bravo`` -> model B
+(manifested ``.npz`` artifacts on disk). Then:
+
+* **cold page-in** — the first ``bravo`` request finds model B
+  resident nowhere: the router demand-pages it (manifest-verified
+  staged load through the swap gates) onto one member and serves
+  bit-identically to the in-process model-B oracle;
+* **affinity steady state** — further ``bravo`` traffic lands on that
+  member without another staged load (residency hits, zero extra
+  page-ins), while ``acme`` traffic rides the other members;
+* **forced eviction** — ``member_resident_bytes`` is sized to hold
+  ONE model: the page-in evicts model A from the paged member (LRU,
+  never pinned, never the active model) and A's traffic keeps
+  serving on the others;
+* **SIGKILL mid-generation** — every worker arms the
+  ``fleet_member_kill`` fault at streamed-token 12; all traffic
+  before the kill phase streams 6 tokens, so a 16-token B request
+  deterministically SIGKILLs the sole B-resident member mid-stream
+  (and the survivor's re-drive only streams the remaining tokens,
+  never tripping its own armed fault). The journal re-pages B onto
+  a survivor BEFORE re-driving: the client gets the token-for-token
+  fault-free generation, zero errors for EITHER tenant, zero journal
+  resets (same model, same weights version, same policy).
+
+Invariants asserted: zero client errors end to end, the kill's
+replay output bit-identical to the oracle, exactly the expected
+page-ins (cold + re-page, none from affinity traffic), at least one
+eviction with the evicted model gone from the member's doc, and
+model A's per-model SLO verdict not alerting. Prints each phase as
+JSON and a final OK line; exits non-zero on any break.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/model_paging_probe.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+import numpy as np  # noqa: E402
+
+import fleet_worker_child as child  # noqa: E402
+
+MAX_NEW = 6
+STEADY_ROUNDS = 6
+
+
+def counter(name, **labels):
+    from paddle_tpu.observability import metrics
+    total = 0.0
+    for s in metrics.REGISTRY.dump().get(name, {}).get("samples", ()):
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            total += s["value"]
+    return total
+
+
+def main():
+    from paddle_tpu.serving import model_paging as mp
+    from paddle_tpu.serving.fleet import FleetRouter
+
+    tmp = tempfile.mkdtemp(prefix="model_paging_probe_")
+    cache_dir = os.path.join(tmp, "compile_cache")
+
+    print("== bring-up: artifacts + oracles + 3 model-A members ==")
+    t0 = time.perf_counter()
+    scope_a = child.build_scope(seed=7)
+    scope_b = child.build_scope(seed=11)
+    path_a = os.path.join(tmp, "A.npz")
+    path_b = os.path.join(tmp, "B.npz")
+    np.savez(path_a, **child.model_params(scope_a))
+    np.savez(path_b, **child.model_params(scope_b))
+    mp.write_weights_manifest(path_a)
+    mp.write_weights_manifest(path_b)
+    nbytes = os.path.getsize(path_a)
+
+    # in-process oracles: the bit-identity reference for each model
+    sched_a = child.make_scheduler(scope_a)
+    sched_b = child.make_scheduler(scope_b)
+
+    def oracle(sched, prompt, n=MAX_NEW):
+        return [int(t) for t in
+                sched.submit(prompt, max_new_tokens=n,
+                             eos_id=-1).result(timeout=300)]
+
+    router = FleetRouter(
+        heartbeat_timeout_ms=700, replay_attempts=6,
+        breaker_failures=3, breaker_cooldown_ms=60000.0,
+        slo_target_p99_ms=60000.0,
+        models={"A": {"params_path": path_a, "tag": "A@v0",
+                      "bytes": nbytes, "tenants": ("acme",)},
+                "B": {"params_path": path_b, "tag": "B@v0",
+                      "bytes": nbytes, "tenants": ("bravo",)}},
+        # room for ONE model per member: paging B in MUST evict A
+        resident_bytes=int(nbytes * 1.5),
+        page_timeout_ms=120000.0)
+    procs = {}
+    stop = threading.Event()
+    acme_thread = None
+
+    def spawn_proc(mid):
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "tests", "fleet_worker_child.py"),
+             "--router", "%s:%d" % router.addr, "--member", mid,
+             "--heartbeat-ms", "150", "--compile-cache", cache_dir,
+             "--model", "A", "--version", "A@v0",
+             # self-kill at streamed token 12: only the 16-token
+             # kill-phase request ever reaches it (everything else
+             # streams MAX_NEW=6), and the post-kill re-drive only
+             # streams the remainder — the survivor stays up
+             "--kill-at-token", "12"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        line = proc.stdout.readline().strip()
+        assert line.startswith("READY"), line
+        procs[mid] = proc
+        return proc
+
+    try:
+        for i in range(3):
+            spawn_proc("m%d" % i)
+        router.wait_members(3, timeout=600)
+        print(json.dumps({"members": router.members_live(),
+                          "model_bytes": nbytes,
+                          "bring_up_sec": round(
+                              time.perf_counter() - t0, 1)}))
+
+        # steady acme traffic for the WHOLE probe: model A must never
+        # see an error, whatever happens to model B's members
+        acme_served, acme_errors = [], []
+
+        def acme_steady():
+            rs = np.random.RandomState(97)
+            while not stop.is_set():
+                p = [child.BOS] + [int(t) for t in
+                                   rs.randint(2, child.VOCAB, 3)]
+                want = oracle(sched_a, p)
+                try:
+                    got = router.submit(
+                        p, max_new_tokens=MAX_NEW, eos_id=-1,
+                        tenant="acme").result(timeout=300)
+                    if [int(t) for t in got] != want:
+                        acme_errors.append(
+                            "tokens diverged: %r != %r"
+                            % (list(got), want))
+                    else:
+                        acme_served.append(1)
+                except Exception as exc:  # noqa: BLE001
+                    acme_errors.append(repr(exc))
+                time.sleep(0.05)
+        acme_thread = threading.Thread(target=acme_steady, daemon=True)
+        acme_thread.start()
+
+        print("== cold page-in: first bravo request ==")
+        misses0 = counter("paddle_fleet_model_residency_misses_total")
+        prompt = [child.BOS, 5, 9]
+        want_b = oracle(sched_b, prompt)
+        t_page0 = time.perf_counter()
+        out = router.submit(prompt, max_new_tokens=MAX_NEW, eos_id=-1,
+                            tenant="bravo",
+                            meta=True).result(timeout=600)
+        page_in_sec = time.perf_counter() - t_page0
+        assert out["tokens"].tolist() == want_b, \
+            (out["tokens"].tolist(), want_b)
+        assert out["version"] == "B@v0", out
+        b_member = out["member"]
+        assert counter(
+            "paddle_fleet_model_residency_misses_total") == misses0 + 1
+        assert counter("paddle_fleet_model_page_ins_total",
+                       outcome="ok") == 1.0
+        print(json.dumps({"paged_onto": b_member,
+                          "cold_request_sec": round(page_in_sec, 1),
+                          "page_in_ms": round(page_in_sec * 1e3)}))
+
+        print("== affinity steady state: bravo sticks, no re-page ==")
+        hits0 = counter("paddle_fleet_model_residency_hits_total")
+        rs = np.random.RandomState(13)
+        for _ in range(STEADY_ROUNDS):
+            p = [child.BOS] + [int(t) for t in
+                               rs.randint(2, child.VOCAB, 3)]
+            want = oracle(sched_b, p)
+            got = router.submit(p, max_new_tokens=MAX_NEW, eos_id=-1,
+                                tenant="bravo",
+                                meta=True).result(timeout=300)
+            assert got["member"] == b_member, (got["member"], b_member)
+            assert got["tokens"].tolist() == want
+        hits = counter(
+            "paddle_fleet_model_residency_hits_total") - hits0
+        assert hits >= STEADY_ROUNDS, hits
+        assert counter("paddle_fleet_model_page_ins_total",
+                       outcome="ok") == 1.0, "affinity re-paged"
+        print(json.dumps({"steady_hits": hits,
+                          "hit_rate": round(
+                              hits / (hits + 1.0), 3)}))
+
+        print("== forced eviction: the B member paged model A out ==")
+        deadline = time.monotonic() + 60
+        while counter("paddle_fleet_model_evictions_total") < 1 and \
+                time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert counter("paddle_fleet_model_evictions_total") >= 1, \
+            "page-in over the byte budget never evicted"
+        doc = router.fleet_doc()
+        b_doc = doc["members"][b_member]
+        assert b_doc["residency"]["models"] == ["B"], b_doc
+        assert b_doc["residency"]["bytes"] <= int(nbytes * 1.5)
+        print(json.dumps({"evictions": counter(
+            "paddle_fleet_model_evictions_total"),
+            "b_member_residency": b_doc["residency"]}))
+
+        print("== SIGKILL the only B-resident member mid-request ==")
+        resets0 = counter("paddle_fleet_journal_resets_total")
+        kill_prompt = [child.BOS, 4, 7, 2]
+        want_kill = oracle(sched_b, kill_prompt, n=16)
+        # 16 > the armed kill-at-token=12: the serving member (the
+        # sole B resident) SIGKILLs itself mid-stream, deterministically
+        fut = router.submit(kill_prompt, max_new_tokens=16, eos_id=-1,
+                            tenant="bravo", meta=True)
+        out = fut.result(timeout=600)
+        assert out["tokens"].tolist() == want_kill, \
+            "replay-with-re-page not bit-identical"
+        assert out["member"] != b_member, out["member"]
+        assert out["replays"] >= 1, out
+        assert counter("paddle_fleet_model_page_ins_total",
+                       outcome="ok") == 2.0, \
+            "the re-drive must have re-paged B on a survivor"
+        assert counter(
+            "paddle_fleet_journal_resets_total") == resets0, \
+            "replay across page-out must not reset the journal"
+        deadline = time.monotonic() + 30
+        while b_member in router.members_live() and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert b_member not in router.members_live()
+        print(json.dumps({"killed": b_member,
+                          "replayed_on": out["member"],
+                          "replays": int(out["replays"]),
+                          "members": router.members_live()}))
+
+        stop.set()
+        acme_thread.join(timeout=300)
+
+        verdicts = {mid: t.verdict()
+                    for mid, t in sorted(router._model_slos.items())}
+        print(json.dumps({
+            "acme": {"served": len(acme_served),
+                     "errors": acme_errors,
+                     "slo_alerting": verdicts["A"]["alerting"]},
+            "page_ins_ok": counter(
+                "paddle_fleet_model_page_ins_total", outcome="ok"),
+            "evictions": counter(
+                "paddle_fleet_model_evictions_total"),
+        }, indent=1))
+        assert not acme_errors, acme_errors
+        assert acme_served, "acme starved"
+        assert not verdicts["A"]["alerting"], verdicts["A"]
+
+        print("MODEL PAGING PROBE OK")
+        return 0
+    finally:
+        stop.set()
+        if acme_thread is not None:
+            acme_thread.join(timeout=30)
+        router.close()
+        sched_a.close()
+        sched_b.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+            p.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
